@@ -1,0 +1,27 @@
+"""distributed_training_comparison_tpu — a TPU-native (JAX/XLA/pjit) rebuild of
+youngerous/distributed-training-comparison.
+
+The reference repo trains a CIFAR-style ResNet on CIFAR-100 three ways (single
+device, single-process DataParallel, multi-process DistributedDataParallel over
+NCCL) and compares accuracy.  This package provides the same capabilities —
+model zoo, data pipeline, trainer (fit/validate/test), AMP-style mixed
+precision, seeded reproducibility, versioned best-checkpoint saving,
+TensorBoard + file logging, argparse config + shell launchers — re-designed
+TPU-first:
+
+- One SPMD training core (``jax.jit`` over a ``jax.sharding.Mesh``) instead of
+  three divergent trainers.  "single", "dp" and "ddp" are mesh shapes, not code
+  forks (reference: ``src/{single,dp,ddp}/trainer.py`` are ~95%-duplicated
+  copies).
+- Gradient all-reduce, per-step barrier, and SyncBatchNorm (reference:
+  ``src/ddp/trainer.py:31,156`` + NCCL) are all subsumed by global-array
+  semantics: a mean over a batch axis that is sharded across devices *is* a
+  cross-device reduction, inserted by XLA over ICI.
+- AMP/GradScaler (reference: ``src/single/trainer.py:135-140``) becomes a
+  bfloat16 compute policy — no loss scaling needed on TPU.
+- The data pipeline is device-resident for CIFAR-sized datasets: the whole
+  dataset lives in HBM and augmentation (pad-4 random crop + hflip) runs inside
+  the jitted step, so steady-state training does zero host↔device transfers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,153 @@
+"""Argparse config system.
+
+Parity: reference ``src/{single,dp,ddp}/config.py`` ``load_config()``.  The
+reference duplicates the parser per variant with small deltas (ckpt path,
+epoch default, ddp-only distributed flags); here one parser serves every
+backend, with the variant passed as ``backend`` by each entry point.
+
+Flag mapping (reference → TPU-native):
+
+====================  =====================================================
+reference flag         meaning here
+====================  =====================================================
+``--amp``              bfloat16 compute policy (no GradScaler — TPU bf16
+                       needs no loss scaling; ref ``src/single/main.py:14``)
+``--workers``          host-side data workers for the streaming pipeline
+                       (unused by the device-resident CIFAR path)
+``--world-size``       number of JAX processes (hosts), for
+                       ``jax.distributed.initialize``
+``--rank``             this process's index among hosts
+``--dist-url``         coordinator address for DCN rendezvous (analogue of
+                       the reference's TCP store ``tcp://127.0.0.1:3456``,
+                       ``src/ddp/config.py:25-26``)
+``--dist-backend``     kept for CLI compatibility; on TPU the collective
+                       fabric is ICI/DCN chosen by XLA, so the only value
+                       is ``"xla"``
+====================  =====================================================
+
+Additional TPU-native flags are grouped at the bottom (mesh shape, precision,
+synthetic data, resume) — capabilities the reference lacks but this framework
+provides.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Sequence
+
+
+def build_parser(backend: str = "single") -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description=f"dtc_tpu {backend} backend",
+    )
+
+    # default hparams (reference src/single/config.py:8-18)
+    parser.add_argument("--dset", type=str, default="cifar100")
+    parser.add_argument("--dpath", type=str, default="data/")
+    parser.add_argument(
+        "--ckpt-path", type=str, default=f"src/{backend}/checkpoints/"
+    )
+    parser.add_argument("--seed", type=int, default=42, help="Seed for reproducibility")
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--eval-step", type=int, default=300)
+    parser.add_argument(
+        "--amp",
+        action="store_true",
+        default=False,
+        help="bfloat16 compute policy (TPU-native AMP; no loss scaling needed)",
+    )
+    parser.add_argument("--contain-test", action="store_true", default=False)
+
+    # distributed hparams (reference src/ddp/config.py:21-26)
+    parser.add_argument(
+        "--world-size", type=int, default=1, help="Total number of host processes"
+    )
+    parser.add_argument("--rank", type=int, default=0, help="This host's process index")
+    parser.add_argument(
+        "--dist-backend",
+        type=str,
+        default="xla",
+        help="Collective backend; XLA emits ICI/DCN collectives (NCCL analogue)",
+    )
+    parser.add_argument(
+        "--dist-url",
+        default="127.0.0.1:3456",
+        type=str,
+        help="Coordinator address for jax.distributed.initialize",
+    )
+
+    # training hparams (reference src/ddp/config.py:29-37)
+    parser.add_argument("--epoch", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=128, help="GLOBAL batch size")
+    parser.add_argument(
+        "--model",
+        type=str,
+        default="resnet18",
+        choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152"],
+        help="Model zoo entry (live, unlike the reference's dead --model flag)",
+    )
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--weight-decay", type=float, default=0.0001)
+    parser.add_argument("--lr-decay-step-size", type=int, default=60)
+    parser.add_argument("--lr-decay-gamma", type=float, default=0.1)
+
+    # TPU-native extensions (no reference equivalent)
+    parser.add_argument(
+        "--num-devices",
+        type=int,
+        default=0,
+        help="Devices to use (0 = all local devices)",
+    )
+    parser.add_argument(
+        "--model-parallel",
+        type=int,
+        default=1,
+        help="Model-parallel mesh axis size (tensor parallelism); "
+        "data-parallel size = num_devices / model_parallel",
+    )
+    parser.add_argument(
+        "--precision",
+        type=str,
+        default=None,
+        choices=[None, "fp32", "bf16"],
+        help="Compute precision; overrides --amp when set",
+    )
+    parser.add_argument(
+        "--synthetic-data",
+        action="store_true",
+        default=False,
+        help="Train on generated data (benchmark mode / no dataset on disk)",
+    )
+    parser.add_argument(
+        "--resume",
+        type=str,
+        default=None,
+        help="Path to a last.ckpt to resume from (full train-state restore; "
+        "capability absent in the reference — see SURVEY.md §5)",
+    )
+    parser.add_argument(
+        "--save-last",
+        action="store_true",
+        default=True,
+        help="Also save a resumable last.ckpt each epoch (on top of the "
+        "reference's best-only policy)",
+    )
+    parser.add_argument(
+        "--log-every-step",
+        action="store_true",
+        default=False,
+        help="Fetch loss every step (reference behavior; costs a device sync "
+        "per step — off by default, metrics are fetched per epoch)",
+    )
+    return parser
+
+
+def load_config(
+    backend: str = "single", argv: Sequence[str] | None = None
+) -> argparse.Namespace:
+    """Parse flags.  ``argv=None`` reads ``sys.argv`` like the reference."""
+    args = build_parser(backend).parse_args(argv)
+    args.backend = backend
+    if args.precision is None:
+        args.precision = "bf16" if args.amp else "fp32"
+    return args

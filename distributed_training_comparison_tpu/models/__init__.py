@@ -1,0 +1,48 @@
+"""Model zoo.
+
+Parity: reference ``src/single/net.py`` (identical copy in all three variant
+dirs) — CIFAR-style ResNet-18/34/50/101/152.  Unlike the reference, the
+``--model`` flag is live: ``get_model`` resolves any zoo entry (the reference
+hardcodes ``ResNet18()`` in every ``main.py`` and leaves the flag dead,
+``src/single/main.py:15`` / ``src/single/config.py:23``).
+"""
+
+from .resnet import (
+    BasicBlock,
+    Bottleneck,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+
+_ZOO = {
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+}
+
+
+def get_model(name: str, **kwargs) -> ResNet:
+    """Build a zoo model by CLI name (e.g. ``"resnet18"``)."""
+    try:
+        return _ZOO[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; choices: {sorted(_ZOO)}") from None
+
+
+__all__ = [
+    "BasicBlock",
+    "Bottleneck",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "get_model",
+]

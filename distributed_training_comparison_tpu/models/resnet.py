@@ -1,0 +1,189 @@
+"""CIFAR-style ResNet family in flax.
+
+Architecture parity with reference ``src/single/net.py:13-136``:
+
+- 3×3 stem, stride 1, **no maxpool** (CIFAR variant, ``net.py:91-92``)
+- stage widths 64/128/256/512, strides 1/2/2/2 (``net.py:95-99``)
+- ``BasicBlock`` (expansion 1, two 3×3 convs, projection shortcut when stride
+  ≠ 1 or channels mismatch, ``net.py:13-45``); ``Bottleneck`` (expansion 4,
+  1×1 → 3×3(stride) → 1×1, ``net.py:48-83``)
+- 4×4 average pool → linear head, ``num_classes=100`` default
+  (``net.py:113-115,87``)
+- depths: 18=[2,2,2,2], 34=[3,4,6,3], 50=Bottleneck[3,4,6,3],
+  101=[3,4,23,3], 152=[3,8,36,3] (``net.py:119-136``)
+
+TPU-native choices (deliberately NOT a torch translation):
+
+- **NHWC** layout — the native layout for TPU convolution emitters (torch is
+  NCHW).  The data pipeline produces NHWC directly.
+- ``dtype`` threads a bfloat16 *compute* policy through every layer while
+  parameters and BatchNorm statistics stay float32 (replaces CUDA-AMP
+  autocast + GradScaler, ``src/single/trainer.py:135-140``).
+- BatchNorm reduces over the batch axis of the **global** array: under
+  ``jit`` over a device mesh with the batch sharded on the data axis, XLA
+  turns the mean/variance into cross-replica reductions — i.e. SyncBatchNorm
+  for free, which the reference explicitly punted on (``README.md:40``).
+- He-normal conv init (standard for ReLU ResNets); BN scale 1 / bias 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# torch BatchNorm2d defaults: eps=1e-5, running-stat update factor 0.1
+# (flax `momentum` is the *decay* of the running stat: 1 - 0.1).
+BN_MOMENTUM = 0.9
+BN_EPS = 1e-5
+
+Conv3x3 = partial(
+    nn.Conv,
+    kernel_size=(3, 3),
+    padding=1,
+    use_bias=False,
+    kernel_init=nn.initializers.he_normal(),
+)
+Conv1x1 = partial(
+    nn.Conv,
+    kernel_size=(1, 1),
+    padding=0,
+    use_bias=False,
+    kernel_init=nn.initializers.he_normal(),
+)
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs; projection shortcut when shape changes."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPS,
+            dtype=self.dtype,
+        )
+        out = Conv3x3(self.planes, strides=self.stride, dtype=self.dtype)(x)
+        out = norm()(out)
+        out = nn.relu(out)
+        out = Conv3x3(self.planes, strides=1, dtype=self.dtype)(out)
+        out = norm()(out)
+
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            shortcut = Conv1x1(
+                self.planes * self.expansion, strides=self.stride, dtype=self.dtype
+            )(x)
+            shortcut = norm()(shortcut)
+        return nn.relu(out + shortcut)
+
+
+class Bottleneck(nn.Module):
+    """1×1 reduce → 3×3 (carries the stride) → 1×1 expand (×4)."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPS,
+            dtype=self.dtype,
+        )
+        out = Conv1x1(self.planes, strides=1, dtype=self.dtype)(x)
+        out = norm()(out)
+        out = nn.relu(out)
+        out = Conv3x3(self.planes, strides=self.stride, dtype=self.dtype)(out)
+        out = norm()(out)
+        out = nn.relu(out)
+        out = Conv1x1(self.planes * self.expansion, strides=1, dtype=self.dtype)(out)
+        out = norm()(out)
+
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            shortcut = Conv1x1(
+                self.planes * self.expansion, strides=self.stride, dtype=self.dtype
+            )(x)
+            shortcut = norm()(shortcut)
+        return nn.relu(out + shortcut)
+
+
+class ResNet(nn.Module):
+    """CIFAR ResNet trunk: stem → 4 stages → pool → linear head."""
+
+    block: Callable[..., nn.Module]
+    num_blocks: Sequence[int]
+    num_classes: int = 100
+    dtype: Any = jnp.float32
+
+    STAGE_WIDTHS = (64, 128, 256, 512)
+    STAGE_STRIDES = (1, 2, 2, 2)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = Conv3x3(64, strides=1, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPS,
+            dtype=self.dtype,
+            name="stem_bn",
+        )(x)
+        x = nn.relu(x)
+        for stage, (planes, stride, blocks) in enumerate(
+            zip(self.STAGE_WIDTHS, self.STAGE_STRIDES, self.num_blocks)
+        ):
+            for i in range(blocks):
+                x = self.block(
+                    planes=planes,
+                    stride=stride if i == 0 else 1,
+                    dtype=self.dtype,
+                    name=f"stage{stage + 1}_block{i}",
+                )(x, train=train)
+        # 4×4 avg_pool on a 4×4 feature map == spatial mean (net.py:113)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.he_normal(),
+            name="head",
+        )(x)
+        # logits in float32 so loss/softmax numerics are stable under bf16
+        return x.astype(jnp.float32)
+
+
+def ResNet18(**kw) -> ResNet:
+    return ResNet(block=BasicBlock, num_blocks=(2, 2, 2, 2), **kw)
+
+
+def ResNet34(**kw) -> ResNet:
+    return ResNet(block=BasicBlock, num_blocks=(3, 4, 6, 3), **kw)
+
+
+def ResNet50(**kw) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 4, 6, 3), **kw)
+
+
+def ResNet101(**kw) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 4, 23, 3), **kw)
+
+
+def ResNet152(**kw) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 8, 36, 3), **kw)

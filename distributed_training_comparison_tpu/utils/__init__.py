@@ -1,0 +1,12 @@
+"""Utilities: seeding, metrics, meters, logging.
+
+Parity target: reference ``src/single/utils.py`` (fix_seed, accuracy,
+AverageMeter) rebuilt for JAX's explicit-PRNG model.
+"""
+
+from .seed import fix_seed
+from .meters import AverageMeter
+from .metrics import accuracy, topk_correct
+from .logging import setup_logger
+
+__all__ = ["fix_seed", "AverageMeter", "accuracy", "topk_correct", "setup_logger"]

@@ -1,0 +1,47 @@
+"""Run logging setup.
+
+Parity: reference ``src/single/trainer.py:65-69`` configures the root logger
+to write ``%(asctime)s > %(message)s`` lines to ``experiment.log`` inside the
+versioned checkpoint dir, and ``src/ddp/trainer.py:58-88`` gates it to rank 0.
+Here the gate is ``jax.process_index() == 0`` (multi-host SPMD analogue of
+DDP rank 0).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from pathlib import Path
+
+
+def setup_logger(
+    log_dir: str | Path | None,
+    name: str = "dtc_tpu",
+    is_main_process: bool = True,
+    to_stdout: bool = True,
+) -> logging.Logger:
+    """Create the experiment logger.
+
+    Non-main processes get a logger with no handlers (silent), mirroring the
+    reference's rank-0-only logging without sprinkling ``if rank == 0`` at
+    every call site.
+    """
+    logger = logging.getLogger(name)
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    logger.propagate = False
+    if not is_main_process:
+        logger.addHandler(logging.NullHandler())
+        return logger
+    fmt = logging.Formatter("%(asctime)s > %(message)s")
+    if to_stdout:
+        sh = logging.StreamHandler(sys.stdout)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+    if log_dir is not None:
+        log_dir = Path(log_dir)
+        log_dir.mkdir(parents=True, exist_ok=True)
+        fh = logging.FileHandler(log_dir / "experiment.log")
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger

@@ -1,0 +1,31 @@
+"""Host-side scalar accumulators.
+
+Parity: reference ``src/single/utils.py:33-47`` (AverageMeter with
+val/sum/count/avg and an n-weighted ``update``).  Used by the Trainer for
+epoch-level aggregation of per-step metrics that were computed on device and
+fetched in bulk (never one ``.item()`` per step — that device sync each step
+is a reference bottleneck we do not replicate, see
+``src/single/trainer.py:147``).
+"""
+
+from __future__ import annotations
+
+
+class AverageMeter:
+    """Tracks the latest value and a running (weighted) average."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val: float, n: int = 1) -> None:
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count if self.count else 0.0

@@ -1,0 +1,31 @@
+"""Seeding for reproducible runs.
+
+Reference: ``src/single/utils.py:7-14`` seeds torch / torch.cuda / numpy /
+random and forces cuDNN-deterministic mode.  JAX is deterministic by
+construction — randomness flows through explicit PRNG keys — so the TPU-native
+equivalent is: seed the host-side generators (numpy/random, used for the
+train/val split and any host-side shuffling) and mint a root ``jax.random``
+key from which all device-side randomness (augmentation, dropout, shuffles)
+is derived by folding.  There is no cuDNN-flag analogue; XLA:TPU is
+deterministic for this workload by default.
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax
+import numpy as np
+
+
+def fix_seed(seed: int) -> jax.Array:
+    """Seed host RNGs and return the root JAX PRNG key for this run.
+
+    Everything random on-device derives from the returned key via
+    ``jax.random.fold_in`` (per epoch, per step), so a (seed, epoch, step)
+    triple always produces the same augmentation/shuffle regardless of
+    device count or host count.
+    """
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.key(seed)

@@ -1,0 +1,56 @@
+"""Config-layer tests: reference flag surface is preserved, TPU extras work."""
+
+from distributed_training_comparison_tpu.config import load_config
+
+
+def test_reference_defaults():
+    cfg = load_config("single", argv=[])
+    # reference src/single/config.py defaults
+    assert cfg.dset == "cifar100"
+    assert cfg.dpath == "data/"
+    assert cfg.seed == 42
+    assert cfg.eval_step == 300
+    assert cfg.amp is False
+    assert cfg.contain_test is False
+    assert cfg.batch_size == 128
+    assert cfg.lr == 0.1
+    assert cfg.weight_decay == 0.0001
+    assert cfg.lr_decay_gamma == 0.1
+    assert cfg.model == "resnet18"
+
+
+def test_reference_launcher_flags_parse():
+    # the exact flag set used by reference run_single.sh:13-22
+    cfg = load_config(
+        "single",
+        argv=[
+            "--seed=42",
+            "--epoch=50",
+            "--batch-size=128",
+            "--lr=0.1",
+            "--weight-decay=0.0001",
+            "--lr-decay-step-size=25",
+            "--lr-decay-gamma=0.1",
+            "--amp",
+            "--contain-test",
+        ],
+    )
+    assert cfg.epoch == 50
+    assert cfg.lr_decay_step_size == 25
+    assert cfg.amp and cfg.contain_test
+    assert cfg.precision == "bf16"  # --amp maps to bf16 policy
+
+
+def test_ddp_flags_parse():
+    cfg = load_config(
+        "ddp",
+        argv=["--world-size=4", "--rank=1", "--dist-url=10.0.0.1:1234"],
+    )
+    assert cfg.world_size == 4 and cfg.rank == 1
+    assert cfg.backend == "ddp"
+    assert "checkpoints" in cfg.ckpt_path and "ddp" in cfg.ckpt_path
+
+
+def test_precision_override():
+    cfg = load_config("single", argv=["--amp", "--precision", "fp32"])
+    assert cfg.precision == "fp32"

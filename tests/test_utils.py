@@ -1,0 +1,88 @@
+"""Unit tests for utils: seeding, meters, metrics.
+
+Covers the semantics of the reference's ``src/single/utils.py`` symbols
+(fix_seed / AverageMeter / accuracy) under the JAX rebuild.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_comparison_tpu.utils import (
+    AverageMeter,
+    accuracy,
+    fix_seed,
+    topk_correct,
+)
+
+
+class TestFixSeed:
+    def test_returns_prng_key(self):
+        key = fix_seed(42)
+        # keys are typed scalars in new-style jax.random
+        assert jax.random.bits(key, (2,)).shape == (2,)
+
+    def test_deterministic(self):
+        k1, k2 = fix_seed(42), fix_seed(42)
+        assert jnp.array_equal(jax.random.bits(k1, (4,)), jax.random.bits(k2, (4,)))
+
+    def test_seeds_numpy(self):
+        fix_seed(7)
+        a = np.random.rand(3)
+        fix_seed(7)
+        b = np.random.rand(3)
+        assert np.array_equal(a, b)
+
+    def test_different_seeds_differ(self):
+        a = jax.random.bits(fix_seed(1), (8,))
+        b = jax.random.bits(fix_seed(2), (8,))
+        assert not jnp.array_equal(a, b)
+
+
+class TestAverageMeter:
+    def test_weighted_average(self):
+        m = AverageMeter()
+        m.update(1.0, n=2)
+        m.update(4.0, n=1)
+        assert m.val == 4.0
+        assert m.sum == 6.0
+        assert m.count == 3
+        assert abs(m.avg - 2.0) < 1e-9
+
+    def test_reset(self):
+        m = AverageMeter()
+        m.update(5.0)
+        m.reset()
+        assert m.val == 0.0 and m.sum == 0.0 and m.count == 0 and m.avg == 0.0
+
+
+class TestAccuracy:
+    def test_top1_perfect(self):
+        logits = jnp.eye(4) * 10.0
+        labels = jnp.arange(4)
+        (top1,) = accuracy(logits, labels, topk=(1,))
+        assert float(top1) == 100.0
+
+    def test_top1_top5_known(self):
+        # one sample: true class is rank 3 in the logits -> top1 miss, top5 hit
+        logits = jnp.array([[5.0, 4.0, 3.0, 2.0, 1.0, 0.0]])
+        labels = jnp.array([2])
+        top1, top5 = accuracy(logits, labels, topk=(1, 5))
+        assert float(top1) == 0.0
+        assert float(top5) == 100.0
+
+    def test_matches_numpy_reference(self):
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=(64, 100)).astype(np.float32)
+        labels = rng.integers(0, 100, size=(64,))
+        for k in (1, 5):
+            order = np.argsort(-logits, axis=1)[:, :k]
+            expected = float(np.mean([l in o for l, o in zip(labels, order)]) * 100)
+            (got,) = accuracy(jnp.asarray(logits), jnp.asarray(labels), topk=(k,))
+            assert abs(float(got) - expected) < 1e-4
+
+    def test_topk_correct_is_jittable(self):
+        f = jax.jit(lambda lg, lb: topk_correct(lg, lb, 5))
+        logits = jnp.ones((8, 10))
+        labels = jnp.zeros((8,), dtype=jnp.int32)
+        assert f(logits, labels).shape == ()
