@@ -109,7 +109,7 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--precision",
         type=str,
         default=None,
-        choices=[None, "fp32", "bf16"],
+        choices=["fp32", "bf16"],
         help="Compute precision; overrides --amp when set",
     )
     parser.add_argument(
@@ -127,10 +127,10 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--save-last",
-        action="store_true",
+        action=argparse.BooleanOptionalAction,
         default=True,
         help="Also save a resumable last.ckpt each epoch (on top of the "
-        "reference's best-only policy)",
+        "reference's best-only policy); --no-save-last for best-only",
     )
     parser.add_argument(
         "--log-every-step",

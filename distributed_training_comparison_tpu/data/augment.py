@@ -1,0 +1,67 @@
+"""Jittable, device-side augmentation.
+
+Parity: reference train-time ``RandomCrop(32, padding=4)`` +
+``RandomHorizontalFlip`` on the host via torchvision/PIL
+(``src/single/dataset.py:55-62``), one python call per sample per step.
+
+TPU-native redesign: augmentation is a pure function of ``(images, key)``
+that runs *inside* the compiled train step on the whole batch at once —
+vectorized, fused by XLA with the normalization and the first conv's input
+cast, and sharded along the batch axis like everything else.  Because the
+key is derived by folding (seed, epoch, step), augmentation is bit-exact
+reproducible for any device/host topology.
+
+Everything here keeps static shapes (pad → dynamic_slice window) so XLA can
+tile it; no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .cifar100 import CIFAR100_MEAN, CIFAR100_STD
+
+
+def _crop_one(padded: jnp.ndarray, dy: jnp.ndarray, dx: jnp.ndarray, size: int) -> jnp.ndarray:
+    return jax.lax.dynamic_slice(padded, (dy, dx, 0), (size, size, padded.shape[-1]))
+
+
+@partial(jax.jit, static_argnames=("padding",))
+def random_crop_flip(images: jnp.ndarray, key: jax.Array, padding: int = 4) -> jnp.ndarray:
+    """Pad-`padding` random crop + horizontal flip over a whole NHWC batch.
+
+    ``images`` may be uint8 or float; dtype is preserved.  One key per call;
+    per-sample randomness is split internally.
+    """
+    b, h, w, _ = images.shape
+    crop_key, flip_key = jax.random.split(key)
+    offsets = jax.random.randint(crop_key, (b, 2), 0, 2 * padding + 1)
+    flips = jax.random.bernoulli(flip_key, 0.5, (b,))
+
+    padded = jnp.pad(
+        images,
+        ((0, 0), (padding, padding), (padding, padding), (0, 0)),
+        mode="constant",
+    )
+    cropped = jax.vmap(_crop_one, in_axes=(0, 0, 0, None))(
+        padded, offsets[:, 0], offsets[:, 1], h
+    )
+    flipped = jnp.where(flips[:, None, None, None], cropped[:, :, ::-1, :], cropped)
+    return flipped
+
+
+def normalize_images(
+    images: jnp.ndarray,
+    mean: tuple[float, ...] = CIFAR100_MEAN,
+    std: tuple[float, ...] = CIFAR100_STD,
+    dtype: jnp.dtype = jnp.float32,
+) -> jnp.ndarray:
+    """uint8 NHWC → normalized float NHWC (torchvision ToTensor+Normalize
+    semantics: scale to [0,1] then per-channel standardize)."""
+    mean_arr = jnp.asarray(mean, dtype=jnp.float32) * 255.0
+    inv_std = 1.0 / (jnp.asarray(std, dtype=jnp.float32) * 255.0)
+    out = (images.astype(jnp.float32) - mean_arr) * inv_std
+    return out.astype(dtype)

@@ -1,0 +1,98 @@
+"""CIFAR-100 loading from the raw python-pickle distribution.
+
+Parity: reference uses ``torchvision.datasets.CIFAR100(download=True)``
+(``src/single/dataset.py:65-77``).  This framework reads the same on-disk
+format (``cifar-100-python/{train,test}`` pickles) directly into numpy — no
+torchvision dependency, no PIL round-trip per sample, and no download inside
+worker processes (the reference itself warns ``download=True`` is not
+multiprocess-safe, ``src/ddp/dataset.py:67-69``; here dataset acquisition is
+explicitly out-of-band).
+
+Accepted layouts under ``dpath``:
+- ``cifar-100-python/train`` and ``cifar-100-python/test`` (the extracted
+  official tarball, what torchvision leaves on disk), or the same two files
+  directly under ``dpath``;
+- ``cifar100.npz`` with arrays ``x_train, y_train, x_test, y_test`` (a
+  convenience cache this module can emit via ``save_npz_cache``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+from pathlib import Path
+
+import numpy as np
+
+# Channel stats used by the reference for train/val (src/single/dataset.py:41-44).
+CIFAR100_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR100_STD = (0.2023, 0.1994, 0.2010)
+# The reference's test-time stats — an acknowledged train/test mismatch
+# (src/single/dataset.py:130-133; SURVEY.md §5 quirk 4). Kept only for
+# reproduction via ``legacy_test_stats``.
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+_SPLIT_FILES = {"train": "train", "test": "test"}
+
+
+def _from_pickle(path: Path) -> tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        entry = pickle.load(f, encoding="bytes")
+    data = entry[b"data"]  # (N, 3072) uint8, CHW-flattened
+    labels = entry.get(b"fine_labels", entry.get(b"labels"))
+    # CHW → HWC: TPU conv emitters are NHWC-native.
+    images = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(images), np.asarray(labels, dtype=np.int32)
+
+
+def _find_split_file(dpath: Path, split: str) -> Path | None:
+    fname = _SPLIT_FILES[split]
+    for cand in (dpath / "cifar-100-python" / fname, dpath / fname):
+        if cand.is_file():
+            return cand
+    return None
+
+
+def load_cifar100(dpath: str | Path, split: str) -> tuple[np.ndarray, np.ndarray]:
+    """Load a CIFAR-100 split as ``(images u8 NHWC, fine_labels i32)``.
+
+    ``split`` is ``"train"`` (50 000) or ``"test"`` (10 000).
+    """
+    if split not in _SPLIT_FILES:
+        raise ValueError(f"split must be 'train' or 'test', got {split!r}")
+    dpath = Path(dpath)
+
+    npz = dpath / "cifar100.npz"
+    if npz.is_file():
+        with np.load(npz) as z:
+            x = z[f"x_{split}"]
+            y = z[f"y_{split}"].astype(np.int32)
+        return x, y
+
+    f = _find_split_file(dpath, split)
+    if f is None:
+        # Extract an official tarball if one was dropped in dpath.
+        tar = dpath / "cifar-100-python.tar.gz"
+        if tar.is_file():
+            with tarfile.open(tar) as t:
+                t.extractall(dpath, filter="data")
+            f = _find_split_file(dpath, split)
+    if f is None:
+        raise FileNotFoundError(
+            f"CIFAR-100 not found under {dpath}. Place the extracted "
+            "'cifar-100-python/' directory, the official tarball "
+            "'cifar-100-python.tar.gz', or a 'cifar100.npz' cache there, or "
+            "run with --synthetic-data."
+        )
+    return _from_pickle(f)
+
+
+def save_npz_cache(dpath: str | Path) -> Path:
+    """Re-emit the pickle distribution as a single fast-loading npz cache."""
+    dpath = Path(dpath)
+    x_train, y_train = load_cifar100(dpath, "train")
+    x_test, y_test = load_cifar100(dpath, "test")
+    out = dpath / "cifar100.npz"
+    np.savez(out, x_train=x_train, y_train=y_train, x_test=x_test, y_test=y_test)
+    return out

@@ -1,0 +1,164 @@
+"""Dataset construction and the loader-parity API.
+
+Parity: reference ``get_trn_val_loader`` / ``get_tst_loader``
+(``src/single/dataset.py:13-158``, ddp variant ``src/ddp/dataset.py``).
+
+Two consumption modes:
+
+- **Device-resident** (`DeviceDataset`, the default for CIFAR-scale data):
+  the whole split is one uint8 array, transferred to HBM once; the trainer
+  shuffles/batches/augments in-jit.  This is the TPU-fast path.
+- **Host-streaming** (`HostLoader`): a numpy mini-batch iterator with
+  per-epoch reshuffle and per-host sharding, for datasets that don't fit in
+  HBM.  ``get_trn_val_loader``/``get_tst_loader`` return these, mirroring
+  the reference's function signatures (sans torch-specific args).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+from .cifar100 import load_cifar100
+from .sampler import shard_indices, train_val_split
+from .synthetic import synthetic_dataset
+
+
+@dataclasses.dataclass
+class DeviceDataset:
+    """A whole split as contiguous arrays, ready for one-shot device_put."""
+
+    images: np.ndarray  # uint8 NHWC
+    labels: np.ndarray  # int32
+    num_classes: int = 100
+    name: str = "cifar100"
+
+    def __post_init__(self) -> None:
+        assert len(self.images) == len(self.labels)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def steps_per_epoch(self, batch_size: int, drop_last: bool = True) -> int:
+        n = len(self)
+        return n // batch_size if drop_last else -(-n // batch_size)
+
+    def subset(self, indices: np.ndarray) -> "DeviceDataset":
+        return DeviceDataset(
+            self.images[indices], self.labels[indices], self.num_classes, self.name
+        )
+
+
+def _raw_split(hparams, split: str) -> tuple[np.ndarray, np.ndarray]:
+    if getattr(hparams, "synthetic_data", False):
+        n = 50_000 if split == "train" else 10_000
+        return synthetic_dataset(n, num_classes=100, seed=hparams.seed + (split == "test"))
+    if hparams.dset != "cifar100":
+        raise ValueError(f"unknown dataset {hparams.dset!r}")
+    return load_cifar100(hparams.dpath, split)
+
+
+def get_datasets(hparams) -> tuple[DeviceDataset, DeviceDataset, DeviceDataset]:
+    """Build (train, valid, test) datasets with the reference's 90/10 split."""
+    images, labels = _raw_split(hparams, "train")
+    full = DeviceDataset(images, labels)
+    trn_idx, val_idx = train_val_split(len(full), valid_size=0.1, seed=hparams.seed)
+    test_images, test_labels = _raw_split(hparams, "test")
+    return (
+        full.subset(trn_idx),
+        full.subset(val_idx),
+        DeviceDataset(test_images, test_labels),
+    )
+
+
+class HostLoader:
+    """Streaming numpy batch iterator with sharding + epoch reshuffle.
+
+    The ``DataLoader(sampler=...)`` analogue.  Call ``set_epoch`` before each
+    pass for a fresh deterministic shuffle (reference
+    ``src/ddp/trainer.py:125``); sharding gives each host its own slice of
+    every epoch's permutation.
+    """
+
+    def __init__(
+        self,
+        dataset: DeviceDataset,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        seed: int = 42,
+        num_shards: int = 1,
+        shard: int = 0,
+    ) -> None:
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_shards = num_shards
+        self.shard = shard
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _indices(self) -> np.ndarray:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng((self.seed, self.epoch)).shuffle(idx)
+        if self.num_shards > 1:
+            idx = shard_indices(idx, self.num_shards, self.shard, even=True)
+        return idx
+
+    def __len__(self) -> int:
+        n = len(self._indices())
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        idx = self._indices()
+        end = (len(idx) // self.batch_size) * self.batch_size if self.drop_last else len(idx)
+        for start in range(0, end, self.batch_size):
+            b = idx[start : start + self.batch_size]
+            yield self.dataset.images[b], self.dataset.labels[b]
+
+
+def get_trn_val_loader(
+    hparams,
+    batch_size: int,
+    *,
+    valid_size: float = 0.1,
+    shuffle: bool = True,
+    num_shards: int = 1,
+    shard: int = 0,
+) -> tuple[HostLoader, HostLoader]:
+    """Reference-shaped API (``src/single/dataset.py:13``): streaming train
+    and valid loaders.  Train is sharded + drop_last (SPMD lockstep); valid
+    is unsharded, mirroring ``src/ddp/dataset.py:109-114``."""
+    train_ds, val_ds, _ = get_datasets(hparams)
+    train_loader = HostLoader(
+        train_ds,
+        batch_size,
+        shuffle=shuffle,
+        drop_last=True,
+        seed=hparams.seed,
+        num_shards=num_shards,
+        shard=shard,
+    )
+    valid_loader = HostLoader(val_ds, batch_size, shuffle=False, seed=hparams.seed)
+    return train_loader, valid_loader
+
+
+def get_tst_loader(
+    hparams, batch_size: int, *, num_shards: int = 1, shard: int = 0
+) -> HostLoader:
+    """Reference-shaped test loader (``src/single/dataset.py:110``).  Sharded
+    with ``even=False`` so a cross-host reduction sees every example exactly
+    once (fixes SURVEY.md §5 quirk 1)."""
+    _, _, test_ds = get_datasets(hparams)
+    if num_shards > 1:
+        idx = shard_indices(np.arange(len(test_ds)), num_shards, shard, even=False)
+        test_ds = test_ds.subset(idx)
+    return HostLoader(test_ds, batch_size, shuffle=False, seed=hparams.seed)

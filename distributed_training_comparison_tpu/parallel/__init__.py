@@ -1,0 +1,43 @@
+"""Parallelism: device mesh, sharding specs, multi-host initialization.
+
+Parity target: the reference's parallelism layer is three divergent code
+paths — plain single-device, ``nn.DataParallel`` scatter/gather
+(``src/dp/trainer.py:27``), and multi-process DDP over NCCL with explicit
+barriers (``src/ddp/main.py:18-23``, ``src/ddp/trainer.py:31,156``).
+
+TPU-native redesign: **one SPMD program, many mesh shapes.**  A
+``jax.sharding.Mesh`` with ``("data", "model")`` axes describes the
+topology; variants are configurations of it:
+
+- single  → 1-device mesh (collectives compile away),
+- dp/ddp  → all local devices on the ``data`` axis; the gradient all-reduce,
+  weight broadcast, and SyncBN are *implied* by array shardings — XLA emits
+  ICI collectives where the math needs them; there is no wrapper class, no
+  explicit barrier (SPMD is lockstep by construction),
+- multi-host → same program after ``jax.distributed.initialize`` (the
+  ``init_process_group`` analogue; DCN rendezvous instead of a TCP store),
+- tensor parallelism → a nontrivial ``model`` axis (capability the
+  reference lacks).
+"""
+
+from .mesh import make_mesh, mesh_shape_for_backend
+from .sharding import (
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+    host_local_batch_slice,
+)
+from .dist import init_distributed, is_main_process, process_count, process_index
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for_backend",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+    "host_local_batch_slice",
+    "init_distributed",
+    "is_main_process",
+    "process_count",
+    "process_index",
+]

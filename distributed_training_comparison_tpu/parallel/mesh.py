@@ -1,0 +1,72 @@
+"""Device mesh construction.
+
+The mesh is the single source of truth for topology.  Axes:
+
+- ``"data"``  — batch-parallel axis (the reference's DP/DDP world),
+- ``"model"`` — tensor-parallel axis (reference has none; size 1 for parity
+  configs).
+
+``jax.experimental.mesh_utils.create_device_mesh`` orders devices so that
+neighboring mesh coordinates are ICI neighbors — collectives ride ICI rings
+rather than hopping arbitrary links.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def mesh_shape_for_backend(
+    backend: str, num_devices: int, model_parallel: int = 1
+) -> tuple[int, int]:
+    """(data, model) mesh shape for a named backend variant.
+
+    ``single`` pins a 1×1 mesh (reference ``src/single/``); ``dp``/``ddp``/
+    ``tpu`` use every available device on the data axis, divided by any
+    tensor-parallel degree.
+    """
+    if backend == "single":
+        return (1, 1)
+    if num_devices % model_parallel != 0:
+        raise ValueError(
+            f"num_devices={num_devices} not divisible by model_parallel={model_parallel}"
+        )
+    return (num_devices // model_parallel, model_parallel)
+
+
+def make_mesh(
+    num_devices: int = 0,
+    model_parallel: int = 1,
+    *,
+    backend: str = "tpu",
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the global ``("data", "model")`` mesh.
+
+    ``num_devices=0`` means all addressable devices (across every host when
+    running under ``jax.distributed``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    shape = mesh_shape_for_backend(backend, len(devices), model_parallel)
+    if shape[0] * shape[1] != len(devices):
+        devices = devices[: shape[0] * shape[1]]
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except (ValueError, AssertionError):
+        # create_device_mesh can reject shapes that don't tile the physical
+        # topology (or CPU test meshes); a plain reshape is always valid.
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,59 @@
+"""Sharding specs and global-array assembly.
+
+Replaces, by construction, three reference mechanisms:
+
+- ``DistributedSampler`` + per-rank batch split (``src/ddp/trainer.py:34``,
+  ``src/ddp/dataset.py:98``) → a batch laid out along the mesh ``data`` axis;
+- DDP's initial weight broadcast (``src/ddp/trainer.py:31``) → replicated
+  param sharding (every device holds the same fp32 copy);
+- bucketed gradient all-reduce in backward → XLA inserts the reduction when
+  a batch-sharded loss is averaged into replicated grads.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis (batch) sharding over the data axis; feature axes and the
+    model axis stay unsharded for pure data parallelism."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated spec — params/opt-state under data parallelism."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a (possibly host-local) numpy batch as a global batch-sharded array.
+
+    Single-host: a straight ``device_put`` with the batch sharding.
+    Multi-host: each process contributes its local shard;
+    ``make_array_from_process_local_data`` assembles the global array — the
+    SPMD replacement for DistributedSampler feeding per-rank loaders.
+    """
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        batch,
+    )
+
+
+def host_local_batch_slice(global_batch_size: int) -> int:
+    """This host's share of the global batch (reference analogue:
+    ``batch_size //= ngpus_per_node``, ``src/ddp/trainer.py:34`` — but per
+    host, not per device; devices are fed by the sharding, not the loader)."""
+    if global_batch_size % jax.process_count() != 0:
+        raise ValueError(
+            f"global batch {global_batch_size} not divisible by "
+            f"{jax.process_count()} processes"
+        )
+    return global_batch_size // jax.process_count()

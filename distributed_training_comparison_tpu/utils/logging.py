@@ -28,6 +28,8 @@ def setup_logger(
     """
     logger = logging.getLogger(name)
     logger.setLevel(logging.INFO)
+    for h in logger.handlers:
+        h.close()
     logger.handlers.clear()
     logger.propagate = False
     if not is_main_process:

@@ -33,6 +33,15 @@ def accuracy(
     logits: jnp.ndarray, labels: jnp.ndarray, topk: Sequence[int] = (1,)
 ) -> list[jnp.ndarray]:
     """Top-k accuracy in percent, matching the reference's return convention
-    (a list, one entry per requested k)."""
+    (a list, one entry per requested k).
+
+    One ``top_k`` at ``max(topk)`` serves every requested k (the top-k index
+    list is sorted by score, so top-1 membership is a prefix of top-5's).
+    """
     batch = logits.shape[0]
-    return [topk_correct(logits, labels, k) * (100.0 / batch) for k in topk]
+    _, top_idx = lax.top_k(logits, max(topk))
+    hits = top_idx == labels[:, None]
+    return [
+        jnp.sum(jnp.any(hits[:, :k], axis=-1).astype(jnp.float32)) * (100.0 / batch)
+        for k in topk
+    ]

@@ -1,0 +1,67 @@
+"""Mesh/sharding tests on the virtual 8-device CPU mesh.
+
+The SPMD analogue of testing DDP without GPUs (SURVEY.md §4): every
+distributed code path runs in CI against
+``--xla_force_host_platform_device_count=8``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu.parallel import (
+    batch_sharding,
+    host_local_batch_slice,
+    make_mesh,
+    mesh_shape_for_backend,
+    replicated_sharding,
+    shard_batch,
+)
+
+
+def test_mesh_shapes_per_backend():
+    assert mesh_shape_for_backend("single", 8) == (1, 1)
+    assert mesh_shape_for_backend("dp", 8) == (8, 1)
+    assert mesh_shape_for_backend("tpu", 8, model_parallel=2) == (4, 2)
+    with pytest.raises(ValueError):
+        mesh_shape_for_backend("tpu", 8, model_parallel=3)
+
+
+def test_make_mesh_all_devices():
+    mesh = make_mesh(backend="dp")
+    assert mesh.shape == {"data": 8, "model": 1}
+    assert make_mesh(backend="single").shape == {"data": 1, "model": 1}
+    assert make_mesh(num_devices=4, backend="ddp").shape == {"data": 4, "model": 1}
+
+
+def test_shard_batch_splits_leading_axis():
+    mesh = make_mesh(backend="dp")
+    batch = {"x": np.arange(64, dtype=np.float32).reshape(16, 4), "y": np.arange(16)}
+    global_batch = shard_batch(batch, mesh)
+    assert global_batch["x"].shape == (16, 4)
+    # each device holds 1/8 of the batch rows
+    shard_shapes = {s.data.shape for s in global_batch["x"].addressable_shards}
+    assert shard_shapes == {(2, 4)}
+    np.testing.assert_array_equal(np.asarray(global_batch["x"]), batch["x"])
+
+
+def test_replicated_sharding_copies_everywhere():
+    mesh = make_mesh(backend="dp")
+    p = jax.device_put(jnp.ones((3, 3)), replicated_sharding(mesh))
+    assert len(p.addressable_shards) == 8
+    assert {s.data.shape for s in p.addressable_shards} == {(3, 3)}
+
+
+def test_sharded_mean_is_global_mean():
+    """A mean over a batch-sharded axis == cross-device all-reduce: the
+    one-line replacement for DDP's NCCL gradient all-reduce."""
+    mesh = make_mesh(backend="dp")
+    x = np.arange(32, dtype=np.float32)
+    gx = jax.device_put(x, batch_sharding(mesh))
+    out = jax.jit(jnp.mean, out_shardings=replicated_sharding(mesh))(gx)
+    assert float(out) == pytest.approx(x.mean())
+
+
+def test_host_local_batch_slice_single_host():
+    assert host_local_batch_slice(256) == 256  # one process in CI
