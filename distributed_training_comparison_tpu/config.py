@@ -119,6 +119,13 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Train on generated data (benchmark mode / no dataset on disk)",
     )
     parser.add_argument(
+        "--limit-examples",
+        type=int,
+        default=0,
+        help="Truncate each split to N examples (0 = full dataset); for "
+        "smoke runs and CI",
+    )
+    parser.add_argument(
         "--resume",
         type=str,
         default=None,
@@ -136,8 +143,16 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--log-every-step",
         action="store_true",
         default=False,
-        help="Fetch loss every step (reference behavior; costs a device sync "
-        "per step — off by default, metrics are fetched per epoch)",
+        help="Write a TensorBoard loss point for every step (reconstructed "
+        "from the per-epoch loss fetch; no extra device syncs)",
+    )
+    parser.add_argument(
+        "--legacy-test-stats",
+        action="store_true",
+        default=False,
+        help="Reproduce the reference's test-set normalization quirk "
+        "(ImageNet stats at test time, src/single/dataset.py:130-133; "
+        "SURVEY.md §5 quirk 4) for comparison runs",
     )
     return parser
 
@@ -146,8 +161,11 @@ def load_config(
     backend: str = "single", argv: Sequence[str] | None = None
 ) -> argparse.Namespace:
     """Parse flags.  ``argv=None`` reads ``sys.argv`` like the reference."""
-    args = build_parser(backend).parse_args(argv)
+    parser = build_parser(backend)
+    args = parser.parse_args(argv)
     args.backend = backend
+    if args.limit_examples < 0:
+        parser.error(f"--limit-examples must be >= 0, got {args.limit_examples}")
     if args.precision is None:
         args.precision = "bf16" if args.amp else "fp32"
     return args

@@ -52,12 +52,23 @@ class DeviceDataset:
 
 
 def _raw_split(hparams, split: str) -> tuple[np.ndarray, np.ndarray]:
+    limit = getattr(hparams, "limit_examples", 0)
     if getattr(hparams, "synthetic_data", False):
         n = 50_000 if split == "train" else 10_000
-        return synthetic_dataset(n, num_classes=100, seed=hparams.seed + (split == "test"))
+        if limit:
+            n = min(n, limit)
+        return synthetic_dataset(
+            n,
+            num_classes=100,
+            seed=hparams.seed + (split == "test"),
+            anchor_seed=hparams.seed,
+        )
     if hparams.dset != "cifar100":
         raise ValueError(f"unknown dataset {hparams.dset!r}")
-    return load_cifar100(hparams.dpath, split)
+    images, labels = load_cifar100(hparams.dpath, split)
+    if limit:
+        images, labels = images[:limit], labels[:limit]
+    return images, labels
 
 
 def get_datasets(hparams) -> tuple[DeviceDataset, DeviceDataset, DeviceDataset]:

@@ -18,15 +18,20 @@ def synthetic_dataset(
     image_shape: tuple[int, int, int] = (32, 32, 3),
     seed: int = 0,
     noise: float = 0.15,
+    anchor_seed: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Generate ``(images u8 NHWC, labels i32)`` with learnable class structure.
 
     Each class gets a fixed random anchor image; samples are
-    ``clip(anchor + noise)``.  Deterministic in ``seed``.
+    ``clip(anchor + noise)``.  Deterministic in ``seed``.  ``anchor_seed``
+    pins the class anchors independently of the sample noise so train and
+    test splits share the same class structure (a model trained on one can
+    be meaningfully evaluated on the other).
     """
     rng = np.random.default_rng(seed)
     labels = rng.integers(0, num_classes, size=n, dtype=np.int32)
-    anchors = rng.uniform(0.0, 1.0, size=(num_classes, *image_shape)).astype(np.float32)
+    anchor_rng = np.random.default_rng(seed if anchor_seed is None else anchor_seed)
+    anchors = anchor_rng.uniform(0.0, 1.0, size=(num_classes, *image_shape)).astype(np.float32)
     x = anchors[labels] + rng.normal(0.0, noise, size=(n, *image_shape)).astype(np.float32)
     images = (np.clip(x, 0.0, 1.0) * 255).astype(np.uint8)
     return images, labels

@@ -1,0 +1,43 @@
+"""Shared entry point behind every backend's ``main.py``.
+
+Parity: reference ``src/{single,dp,ddp}/main.py`` — load config, seed, build
+model + Trainer, ``fit()``, then (under ``--contain-test``) load the best
+checkpoint of the run and ``test()`` (``src/single/main.py:12-33``,
+``src/ddp/main.py:14-49``).
+
+The reference's ddp ``main`` additionally forks one process per GPU with
+``mp.spawn`` and computes global ranks (``src/ddp/main.py:43-49``).  There
+is no analogue here: one process drives every local TPU chip, and
+multi-host runs launch this same entry once per host with
+``--world-size/--rank`` set (``jax.distributed.initialize`` replaces
+``init_process_group``; see ``parallel/dist.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .config import load_config
+from .parallel import init_distributed, is_main_process
+from .train import Trainer
+
+
+def run(backend: str, argv: Sequence[str] | None = None) -> dict:
+    """Train (and optionally test) one run of the given backend variant."""
+    hparams = load_config(backend, argv)
+    init_distributed(hparams)
+
+    trainer = Trainer(hparams)
+    results: dict = {}
+    try:
+        results["version"] = trainer.fit()
+        if hparams.contain_test:
+            # Test on the best checkpoint of the run we just trained —
+            # process-0 metrics are already global (every example counted
+            # once; unlike the reference's rank-0-tests-its-own-shard quirk).
+            results.update(trainer.test())
+    finally:
+        trainer.close()
+    if is_main_process():
+        print(results)
+    return results

@@ -1,0 +1,120 @@
+"""Checkpointing: versioned run dirs, best-only policy, full resume state.
+
+Parity: reference ``save_checkpoint`` — scan ``version-{n}`` dirs for the
+first free slot (``src/single/trainer.py:52-59``), on val-top1 improvement
+delete all old ``*.pt`` then save the model state as
+``best_model_epoch_{e}_acc_{a}.pt`` (``:96-107``, ``:115-117``), rank-0-only
+under ddp (``src/ddp/trainer.py:131-132``).  The reference saves **only**
+model weights — no optimizer/scheduler/step — so a killed run cannot resume
+(SURVEY.md §5).  Here ``last.ckpt`` carries the full train state (params, BN
+stats, optimizer state, step, epoch, best-acc), making mid-run resume a
+first-class capability.
+
+Format: flax msgpack serialization of host-fetched pytrees — a single
+portable file, no framework-pickle coupling (torch.load arbitrary-code
+pickle is the reference's load path, ``src/single/main.py:25``).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+from flax import serialization
+
+from .state import TrainState
+
+BEST_PREFIX = "best_model_"
+LAST_NAME = "last.ckpt"
+
+
+def find_version_dir(ckpt_root: str | Path, create: bool = True) -> Path:
+    """First nonexistent ``version-{n}`` under ``ckpt_root`` (reference
+    ``src/single/trainer.py:52-59``)."""
+    root = Path(ckpt_root)
+    n = 0
+    while (root / f"version-{n}").exists():
+        n += 1
+    d = root / f"version-{n}"
+    if create:
+        d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _state_dict(state: TrainState) -> dict[str, Any]:
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_acc: float) -> Path:
+    """Best-only save: drop previous best files, write the new one.
+
+    File carries params + batch_stats (what inference needs); the resumable
+    full state lives in ``last.ckpt``.
+    """
+    version_dir = Path(version_dir)
+    for old in version_dir.glob(f"{BEST_PREFIX}*.ckpt"):
+        old.unlink()
+    payload = {
+        "params": serialization.to_state_dict(_to_host(state.params)),
+        "batch_stats": serialization.to_state_dict(_to_host(state.batch_stats)),
+        "epoch": epoch,
+        "val_acc": float(val_acc),
+    }
+    path = version_dir / f"{BEST_PREFIX}epoch_{epoch}_acc_{val_acc:.4f}.ckpt"
+    path.write_bytes(serialization.msgpack_serialize(payload))
+    return path
+
+
+def load_checkpoint(path: str | Path, state: TrainState) -> TrainState:
+    """Restore params/batch_stats from a best checkpoint into ``state``."""
+    raw = serialization.msgpack_restore(Path(path).read_bytes())
+    params = serialization.from_state_dict(state.params, raw["params"])
+    batch_stats = serialization.from_state_dict(state.batch_stats, raw["batch_stats"])
+    return state.replace(params=params, batch_stats=batch_stats)
+
+
+def find_best_checkpoint(version_dir: str | Path) -> Path | None:
+    """Glob the best file like the reference's test phase
+    (``src/single/main.py:23-27``)."""
+    hits = sorted(Path(version_dir).glob(f"{BEST_PREFIX}*.ckpt"))
+    return hits[-1] if hits else None
+
+
+def save_resume_state(
+    version_dir: str | Path, state: TrainState, epoch: int, best_acc: float
+) -> Path:
+    """Write the fully-resumable ``last.ckpt`` (capability the reference lacks)."""
+    payload = {
+        "state": serialization.to_state_dict(_to_host(_state_dict(state))),
+        "epoch": epoch,
+        "best_acc": float(best_acc),
+    }
+    path = Path(version_dir) / LAST_NAME
+    tmp = path.with_suffix(".tmp")  # atomic-ish: never leave a torn last.ckpt
+    tmp.write_bytes(serialization.msgpack_serialize(payload))
+    tmp.replace(path)
+    return path
+
+
+def load_resume_state(path: str | Path, state: TrainState) -> tuple[TrainState, int, float]:
+    """Restore ``(state, next_epoch, best_acc)`` from a ``last.ckpt``."""
+    raw = serialization.msgpack_restore(Path(path).read_bytes())
+    restored = serialization.from_state_dict(_state_dict(state), raw["state"])
+    state = state.replace(
+        step=restored["step"],
+        params=restored["params"],
+        batch_stats=restored["batch_stats"],
+        opt_state=restored["opt_state"],
+    )
+    return state, int(raw["epoch"]) + 1, float(raw["best_acc"])

@@ -1,0 +1,319 @@
+"""The Trainer: fit / validate / test over a device mesh.
+
+Parity: reference ``Trainer`` (``src/single/trainer.py:18-228``,
+``src/ddp/trainer.py:20-252``) — constructor wires model/optimizer/data/
+logging/checkpointing; ``fit`` runs the epoch loop with per-``eval_step``
+train-loss logging, per-epoch validation, best-checkpoint saving and LR
+stepping; ``test`` reports loss/top-1/top-5.
+
+One Trainer serves every variant (the reference maintains three ~95%%
+identical copies): the mesh shape — (1,1) single, (n,1) data-parallel,
+multi-host after ``jax.distributed.initialize`` — is the only difference.
+
+TPU-native structure of ``fit``:
+
+- the whole epoch is ONE device program (``make_epoch_runner`` ``lax.scan``)
+  over the HBM-resident dataset; the host touches the device once per epoch
+  to fetch the stacked per-step losses — the reference's per-step
+  ``loss.item()`` sync (``src/single/trainer.py:147``) and per-step H2D
+  copies disappear;
+- the reference's every-``eval_step``-global-steps log lines are
+  reconstructed exactly from the stacked loss array after the fact;
+- validation/test use a padded fixed-shape batch + weight mask so every
+  example counts once on any mesh (fixes SURVEY.md §5 quirk 1);
+- process-0 gating covers logging/TB/checkpoints (``src/ddp/trainer.py``
+  rank-0 gates), but metrics are already global — no local-loss-only
+  logging quirk.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import get_datasets
+from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
+from ..models import get_model
+from ..parallel import is_main_process, make_mesh, replicated_sharding
+from ..utils import AverageMeter, fix_seed, setup_logger
+from ..utils.tensorboard import SummaryWriter
+from . import checkpoint as ckpt
+from .optim import configure_optimizers
+from .state import create_train_state
+from .step import make_epoch_runner, make_eval_step
+
+
+def _pad_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
+    """Pad a split to a whole number of fixed-shape batches + weight mask."""
+    n = len(images)
+    nb = -(-n // batch_size)
+    pad = nb * batch_size - n
+    if pad:
+        images = np.concatenate([images, np.repeat(images[:1], pad, axis=0)])
+        labels = np.concatenate([labels, np.repeat(labels[:1], pad, axis=0)])
+    weights = np.ones(nb * batch_size, np.float32)
+    if pad:
+        weights[-pad:] = 0.0
+    return images, labels, weights
+
+
+class Trainer:
+    """Drives training of a model over a mesh; one instance per run."""
+
+    def __init__(self, hparams, model=None, mesh=None):
+        self.hparams = hparams
+        self.mesh = mesh if mesh is not None else make_mesh(
+            hparams.num_devices, hparams.model_parallel, backend=hparams.backend
+        )
+        n_data = self.mesh.shape["data"]
+        if hparams.batch_size % n_data:
+            raise ValueError(
+                f"global batch {hparams.batch_size} not divisible by data-parallel "
+                f"size {n_data}"
+            )
+
+        self.root_key = fix_seed(hparams.seed)
+        self.precision = hparams.precision
+        compute_dtype = jnp.bfloat16 if self.precision == "bf16" else jnp.float32
+        self.model = model if model is not None else get_model(
+            hparams.model, dtype=compute_dtype
+        )
+
+        # --- data (device-resident, replicated; sharding happens per-batch
+        # inside the compiled epoch via with_sharding_constraint)
+        trn, val, tst = get_datasets(hparams)
+        repl = replicated_sharding(self.mesh)
+        if len(trn) < hparams.batch_size or len(val) == 0:
+            raise ValueError(
+                f"dataset too small after split: {len(trn)} train / {len(val)} "
+                f"val examples for batch size {hparams.batch_size} "
+                "(raise --limit-examples or lower --batch-size)"
+            )
+        self.trn_images = jax.device_put(trn.images, repl)
+        self.trn_labels = jax.device_put(trn.labels, repl)
+        self.steps_per_epoch = trn.steps_per_epoch(hparams.batch_size, drop_last=True)
+        self._val = tuple(
+            jax.device_put(a, repl)
+            for a in _pad_batches(val.images, val.labels, hparams.batch_size)
+        )
+        self._tst = tuple(
+            jax.device_put(a, repl)
+            for a in _pad_batches(tst.images, tst.labels, hparams.batch_size)
+        )
+
+        # --- optimizer + state
+        self.tx, self.lr_schedule = configure_optimizers(hparams, self.steps_per_epoch)
+        init_key, self.data_key = jax.random.split(self.root_key)
+        with jax.default_device(jax.devices()[0]):
+            state = create_train_state(self.model, init_key, self.tx)
+        self.state = jax.device_put(state, repl)
+
+        # --- compiled programs
+        test_stats = (
+            (IMAGENET_MEAN, IMAGENET_STD)
+            if getattr(hparams, "legacy_test_stats", False)
+            else (CIFAR100_MEAN, CIFAR100_STD)
+        )
+        self.epoch_runner = make_epoch_runner(
+            self.mesh, hparams.batch_size, precision=self.precision
+        )
+        self.eval_step = make_eval_step(self.mesh, precision=self.precision)
+        self.test_eval_step = make_eval_step(
+            self.mesh, precision=self.precision, mean=test_stats[0], std=test_stats[1]
+        )
+
+        # --- run dir, logging, provenance (process-0 only)
+        self.is_main = is_main_process()
+        # -1 so the first validation always produces a best checkpoint, even
+        # at 0.0% val accuracy (with 100 classes and a small val split that
+        # is a reachable score; the reference's 0-init would then never save)
+        self.best_acc = -1.0
+        self.start_epoch = 0
+        self.version_dir: Path | None = None
+        self.writer = None
+        if self.is_main:
+            self.version_dir = ckpt.find_version_dir(hparams.ckpt_path)
+            self.writer = SummaryWriter(self.version_dir / "tb")
+            self._dump_hparams()
+        self.logger = setup_logger(
+            self.version_dir, is_main_process=self.is_main, to_stdout=True
+        )
+        self.version = (
+            int(self.version_dir.name.split("-")[1]) if self.version_dir else -1
+        )
+
+        if getattr(hparams, "resume", None):
+            self.state, self.start_epoch, self.best_acc = ckpt.load_resume_state(
+                hparams.resume, self.state
+            )
+            self.logger.info(
+                f"Resumed from {hparams.resume} at epoch {self.start_epoch} "
+                f"(best acc {self.best_acc:.4f})"
+            )
+
+    # ------------------------------------------------------------------ utils
+
+    def _dump_hparams(self) -> None:
+        """hparams.yaml provenance dump (reference ``src/single/trainer.py:70-73``)."""
+        items = sorted(vars(self.hparams).items())
+        try:
+            import yaml
+
+            text = yaml.safe_dump({k: v for k, v in items})
+        except ImportError:
+            text = "".join(f"{k}: {v}\n" for k, v in items)
+        (self.version_dir / "hparams.yaml").write_text(text)
+
+    def _log_tb(self, tag: str, value: float, step: int) -> None:
+        if self.writer is not None:
+            self.writer.add_scalar(tag, value, step)
+
+    # ------------------------------------------------------------------ train
+
+    def fit(self) -> int:
+        """Epoch loop; returns the version number (reference ``fit`` contract,
+        ``src/single/trainer.py:109-120``)."""
+        hp = self.hparams
+        self.logger.info(
+            f"[{hp.backend.upper()} Version {self.version}] start training: "
+            f"{hp.epoch} epochs, {self.steps_per_epoch} steps/epoch, "
+            f"global batch {hp.batch_size}, mesh {dict(self.mesh.shape)}, "
+            f"{self.precision}"
+        )
+        t_start = time.perf_counter()
+        for epoch in range(self.start_epoch, hp.epoch):
+            t0 = time.perf_counter()
+            self.state, stacked = self.epoch_runner(
+                self.state,
+                self.trn_images,
+                self.trn_labels,
+                self.data_key,
+                jnp.asarray(epoch),
+            )
+            losses = np.asarray(stacked["loss"])  # one host fetch per epoch
+            top1 = float(np.sum(np.asarray(stacked["top1_count"])))
+            epoch_time = time.perf_counter() - t0
+            imgs = self.steps_per_epoch * hp.batch_size
+
+            meter = AverageMeter()
+            for i, loss in enumerate(losses):
+                gstep = epoch * self.steps_per_epoch + i
+                meter.update(float(loss))
+                if (gstep + 1) % hp.eval_step == 0:
+                    self.logger.info(
+                        f"[{hp.backend.upper()} Version {self.version} "
+                        f"Epoch {epoch}] global step {gstep + 1}, "
+                        f"train loss: {meter.avg:.4f}"
+                    )
+                if getattr(hp, "log_every_step", False):
+                    self._log_tb("loss/step", float(loss), gstep)
+
+            val = self.validate(epoch)
+            lr_now = float(self.lr_schedule(epoch * self.steps_per_epoch))
+            self.logger.info(
+                f"[{hp.backend.upper()} Version {self.version} Epoch {epoch}] "
+                f"train loss: {meter.avg:.4f}, train acc: {100.0 * top1 / imgs:.2f}%, "
+                f"val loss: {val['val_loss']:.4f}, val acc: {val['val_acc']:.2f}%, "
+                f"lr: {lr_now:.4f}, {imgs / epoch_time:.0f} img/s"
+            )
+            self._log_tb("lr", lr_now, epoch)
+            self._log_tb("loss/epoch/train", meter.avg, epoch)
+            self._log_tb("loss/epoch/val", val["val_loss"], epoch)
+            self._log_tb("acc/epoch/val", val["val_acc"], epoch)
+            self._log_tb("throughput/images_per_sec", imgs / epoch_time, epoch)
+
+            if self.is_main:
+                if val["val_acc"] > self.best_acc:
+                    self.best_acc = val["val_acc"]
+                    ckpt.save_checkpoint(
+                        self.version_dir, self.state, epoch, self.best_acc
+                    )
+                if getattr(hp, "save_last", True):
+                    ckpt.save_resume_state(
+                        self.version_dir, self.state, epoch, self.best_acc
+                    )
+        self.logger.info(
+            f"[{hp.backend.upper()} Version {self.version}] done in "
+            f"{time.perf_counter() - t_start:.1f}s, best val acc {self.best_acc:.2f}%"
+        )
+        return self.version
+
+    # ------------------------------------------------------------------- eval
+
+    def _run_eval(self, arrays, eval_step):
+        images, labels, weights = arrays
+        bs = self.hparams.batch_size
+        nb = len(weights) // bs
+        totals = {"loss_sum": 0.0, "top1_count": 0.0, "top5_count": 0.0, "count": 0.0}
+        device_totals = []
+        for b in range(nb):
+            sl = slice(b * bs, (b + 1) * bs)
+            device_totals.append(
+                eval_step(self.state, images[sl], labels[sl], weights[sl])
+            )
+        for m in device_totals:  # fetch after all dispatches (pipelined)
+            for k in totals:
+                totals[k] += float(m[k])
+        out = {
+            "loss": totals["loss_sum"] / totals["count"],
+            "top1": 100.0 * totals["top1_count"] / totals["count"],
+            "top5": 100.0 * totals["top5_count"] / totals["count"],
+        }
+        return out
+
+    def validate(self, epoch: int) -> dict[str, float]:
+        """Whole-val-set metrics (reference ``validate``,
+        ``src/single/trainer.py:175-194``)."""
+        out = self._run_eval(self._val, self.eval_step)
+        return {"val_loss": out["loss"], "val_acc": out["top1"]}
+
+    def test(self, state=None) -> dict[str, float]:
+        """Test-set loss/top-1/top-5 (reference ``test``,
+        ``src/single/trainer.py:196-228``).  ``state=None`` loads the best
+        checkpoint from this run's version dir, mirroring the reference's
+        glob-and-load phase (``src/single/main.py:22-28``)."""
+        if state is None:
+            best = (
+                ckpt.find_best_checkpoint(self.version_dir)
+                if self.version_dir is not None
+                else None
+            )
+            if best is not None:
+                self.logger.info(f"Loading best checkpoint: {best.name}")
+                self.state = ckpt.load_checkpoint(best, self.state)
+            if jax.process_count() > 1:
+                # Only process 0 has the checkpoint on disk; broadcast its
+                # params/BN stats so every host evaluates the same model
+                # (the reference instead lets rank 0 test alone on 1/N of
+                # the data — SURVEY.md §5 quirk 1).
+                from jax.experimental import multihost_utils
+
+                synced = multihost_utils.broadcast_one_to_all(
+                    jax.device_get((self.state.params, self.state.batch_stats))
+                )
+                repl = replicated_sharding(self.mesh)
+                self.state = self.state.replace(
+                    params=jax.device_put(synced[0], repl),
+                    batch_stats=jax.device_put(synced[1], repl),
+                )
+        else:
+            self.state = state
+        out = self._run_eval(self._tst, self.test_eval_step)
+        self.logger.info(
+            f"[{self.hparams.backend.upper()} Version {self.version}] "
+            f"test loss: {out['loss']:.4f}, "
+            f"test top-1 acc: {out['top1']:.2f}%, top-5 acc: {out['top5']:.2f}%"
+        )
+        return {
+            "test_loss": out["loss"],
+            "test_top1": out["top1"],
+            "test_top5": out["top5"],
+        }
+
+    def close(self) -> None:
+        if self.writer is not None:
+            self.writer.close()

@@ -1,0 +1,21 @@
+"""Distributed data-parallel entry point.
+
+Parity: reference ``src/ddp/main.py`` — ``mp.spawn`` per GPU,
+``dist.init_process_group`` over NCCL, per-rank batch splitting, explicit
+barriers (``src/ddp/main.py:14-49``, ``src/ddp/trainer.py:31,34,156``).
+
+TPU-native: one process per *host* drives all local chips; the gradient
+all-reduce/broadcast/barrier are implied by array shardings (SPMD is
+lockstep by construction).  For multi-host, launch this once per host with
+``--world-size N --rank i --dist-url host:port``.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from distributed_training_comparison_tpu.entry import run
+
+if __name__ == "__main__":
+    run("ddp")

@@ -1,0 +1,25 @@
+#!/bin/sh
+# Launch config parity: reference src/ddp/run_ddp.sh (GLOBAL batch 256 —
+# the reference splits it per rank, src/ddp/trainer.py:34; here the mesh
+# shards it). Multi-host: add --world-size N --rank i --dist-url host:port
+# per host.
+EPOCH=50
+BATCH_SIZE=256
+SEED=42
+LR=0.1
+LR_STEP=25
+LR_GAMMA=0.1
+WEIGHT_DECAY=1e-4
+
+python src/ddp/main.py \
+  --epoch ${EPOCH} \
+  --batch-size ${BATCH_SIZE} \
+  --seed ${SEED} \
+  --lr ${LR} \
+  --lr-decay-step-size ${LR_STEP} \
+  --lr-decay-gamma ${LR_GAMMA} \
+  --weight-decay ${WEIGHT_DECAY} \
+  --ckpt-path src/ddp/checkpoints/ \
+  --amp \
+  --contain-test \
+  "$@"

@@ -1,0 +1,17 @@
+"""Data-parallel (single-process, all local devices) entry point.
+
+Parity: reference ``src/dp/main.py`` + ``nn.DataParallel`` wrapping
+(``src/dp/trainer.py:27``).  On TPU there is no scatter/gather wrapper: the
+batch is laid out along the mesh's data axis and XLA keeps compute where the
+data is — DP and DDP collapse into the same SPMD program.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from distributed_training_comparison_tpu.entry import run
+
+if __name__ == "__main__":
+    run("dp")

@@ -1,0 +1,23 @@
+#!/bin/sh
+# Launch config parity: reference src/dp/run_dp.sh (batch 128 across all
+# local devices; otherwise identical to the single recipe).
+EPOCH=50
+BATCH_SIZE=128
+SEED=42
+LR=0.1
+LR_STEP=25
+LR_GAMMA=0.1
+WEIGHT_DECAY=1e-4
+
+python src/dp/main.py \
+  --epoch ${EPOCH} \
+  --batch-size ${BATCH_SIZE} \
+  --seed ${SEED} \
+  --lr ${LR} \
+  --lr-decay-step-size ${LR_STEP} \
+  --lr-decay-gamma ${LR_GAMMA} \
+  --weight-decay ${WEIGHT_DECAY} \
+  --ckpt-path src/dp/checkpoints/ \
+  --amp \
+  --contain-test \
+  "$@"

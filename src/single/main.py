@@ -1,0 +1,15 @@
+"""Single-device entry point.
+
+Parity: reference ``src/single/main.py`` — a 1×1 mesh: same compiled program
+as every other backend, with collectives compiled away.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from distributed_training_comparison_tpu.entry import run
+
+if __name__ == "__main__":
+    run("single")

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Launch config parity: reference src/single/run_single.sh:3-22
+# (50 epochs, batch 128, SGD lr 0.1 + StepLR(25, x0.1), wd 1e-4, seed 42,
+#  AMP on, test phase contained in the run).
+EPOCH=50
+BATCH_SIZE=128
+SEED=42
+LR=0.1
+LR_STEP=25
+LR_GAMMA=0.1
+WEIGHT_DECAY=1e-4
+
+python src/single/main.py \
+  --epoch ${EPOCH} \
+  --batch-size ${BATCH_SIZE} \
+  --seed ${SEED} \
+  --lr ${LR} \
+  --lr-decay-step-size ${LR_STEP} \
+  --lr-decay-gamma ${LR_GAMMA} \
+  --weight-decay ${WEIGHT_DECAY} \
+  --ckpt-path src/single/checkpoints/ \
+  --amp \
+  --contain-test \
+  "$@"

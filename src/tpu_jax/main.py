@@ -1,0 +1,17 @@
+"""TPU-JAX entry point — the north-star backend (BASELINE.md).
+
+The full mesh: all devices on the data axis by default, with
+``--model-parallel`` carving out a tensor-parallel axis (capability the
+reference lacks), bf16 via ``--amp``/``--precision bf16``, cross-replica
+BatchNorm by construction.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from distributed_training_comparison_tpu.entry import run
+
+if __name__ == "__main__":
+    run("tpu")

@@ -1,0 +1,23 @@
+#!/bin/sh
+# North-star config (BASELINE.md): ResNet-18 / CIFAR-100, global batch 256
+# over the full TPU mesh, bf16, cross-replica BN, target >=71% top-1.
+EPOCH=50
+BATCH_SIZE=256
+SEED=42
+LR=0.1
+LR_STEP=25
+LR_GAMMA=0.1
+WEIGHT_DECAY=1e-4
+
+python src/tpu_jax/main.py \
+  --epoch ${EPOCH} \
+  --batch-size ${BATCH_SIZE} \
+  --seed ${SEED} \
+  --lr ${LR} \
+  --lr-decay-step-size ${LR_STEP} \
+  --lr-decay-gamma ${LR_GAMMA} \
+  --weight-decay ${WEIGHT_DECAY} \
+  --ckpt-path src/tpu_jax/checkpoints/ \
+  --amp \
+  --contain-test \
+  "$@"

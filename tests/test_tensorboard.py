@@ -1,0 +1,57 @@
+"""Event-writer wire-format tests, validated against tensorboard's own
+readers/protos (available in the image's TF stack, but NOT a runtime
+dependency of the framework)."""
+
+import glob
+
+import pytest
+
+from distributed_training_comparison_tpu.utils.tensorboard import (
+    SummaryWriter,
+    _event,
+    _scalar_summary,
+    crc32c,
+)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert crc32c(b"") == 0x0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_proto_bytes_match_real_protobuf():
+    event_pb2 = pytest.importorskip("tensorboard.compat.proto.event_pb2")
+    e = event_pb2.Event()
+    e.wall_time = 123.5
+    e.step = 7
+    v = e.summary.value.add()
+    v.tag = "loss/step"
+    v.simple_value = 2.5
+    mine = _event(123.5, 7, summary=_scalar_summary("loss/step", 2.5))
+    assert mine == e.SerializeToString()
+
+
+def test_event_file_roundtrip(tmp_path):
+    loader_mod = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_file_loader"
+    )
+    event_pb2 = pytest.importorskip("tensorboard.compat.proto.event_pb2")
+    with SummaryWriter(tmp_path) as w:
+        w.add_scalar("acc/epoch", 71.17, 50)
+        w.add_scalar("lr", 0.1, 0)
+    f = glob.glob(str(tmp_path / "events.out.tfevents.*"))[0]
+    events = []
+    for raw in loader_mod.RawEventFileLoader(f).Load():
+        e = event_pb2.Event()
+        e.ParseFromString(raw)
+        events.append(e)
+    assert events[0].file_version == "brain.Event:2"
+    scalars = {
+        e.summary.value[0].tag: (e.step, e.summary.value[0].simple_value)
+        for e in events[1:]
+    }
+    assert scalars["acc/epoch"][0] == 50
+    assert scalars["acc/epoch"][1] == pytest.approx(71.17, abs=1e-4)
+    assert scalars["lr"] == (0, pytest.approx(0.1))

@@ -1,0 +1,85 @@
+"""End-to-end Trainer tests on the 8-device CPU mesh: fit → artifacts →
+test → resume, with a tiny model standing in for the (CPU-prohibitive)
+ResNet flagship.  This is the 'src/single slice end-to-end' of SURVEY.md §7
+step 4, exercised hermetically."""
+
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.train import Trainer
+
+from test_train import TinyNet
+
+
+def _hparams(tmp_path, extra=()):
+    return load_config(
+        "ddp",
+        argv=[
+            "--synthetic-data",
+            "--limit-examples", "256",
+            "--batch-size", "64",
+            "--epoch", "2",
+            "--eval-step", "2",
+            "--lr", "0.05",
+            "--ckpt-path", str(tmp_path),
+            *extra,
+        ],
+    )
+
+
+@pytest.fixture(scope="module")
+def run_dir(tmp_path_factory):
+    """One fit() shared by the artifact assertions below."""
+    tmp_path = tmp_path_factory.mktemp("run")
+    hp = _hparams(tmp_path)
+    trainer = Trainer(hp, model=TinyNet(num_classes=100))
+    version = trainer.fit()
+    results = trainer.test()
+    trainer.close()
+    return tmp_path, version, results, trainer
+
+
+def test_fit_returns_version_and_artifacts(run_dir):
+    tmp_path, version, results, _ = run_dir
+    vdir = tmp_path / f"version-{version}"
+    assert version == 0
+    assert (vdir / "experiment.log").exists()
+    assert (vdir / "hparams.yaml").exists()
+    assert (vdir / "last.ckpt").exists()
+    assert list(vdir.glob("best_model_*.ckpt"))
+    assert list((vdir / "tb").glob("events.out.tfevents.*"))
+    log = (vdir / "experiment.log").read_text()
+    assert "start training" in log and "val acc" in log
+
+
+def test_hparams_yaml_roundtrip(run_dir):
+    yaml = pytest.importorskip("yaml")
+    tmp_path, version, _, _ = run_dir
+    loaded = yaml.safe_load((tmp_path / f"version-{version}" / "hparams.yaml").read_text())
+    assert loaded["batch_size"] == 64 and loaded["backend"] == "ddp"
+
+
+def test_test_metrics_shape(run_dir):
+    _, _, results, _ = run_dir
+    assert set(results) == {"test_loss", "test_top1", "test_top5"}
+    assert 0.0 <= results["test_top1"] <= results["test_top5"] <= 100.0
+    assert results["test_loss"] > 0
+
+
+def test_resume_continues(run_dir, tmp_path):
+    src_tmp, version, _, trainer = run_dir
+    last = src_tmp / f"version-{version}" / "last.ckpt"
+    hp = _hparams(tmp_path, extra=["--resume", str(last), "--epoch", "3"])
+    t2 = Trainer(hp, model=TinyNet(num_classes=100))
+    assert t2.start_epoch == 2  # resumes after the 2 completed epochs
+    assert int(np.asarray(t2.state.step)) == int(np.asarray(trainer.state.step))
+    t2.fit()  # one more epoch runs without error
+    t2.close()
+
+
+def test_batch_not_divisible_raises(tmp_path):
+    hp = _hparams(tmp_path)
+    hp.batch_size = 60  # not divisible by 8-device data axis
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(hp, model=TinyNet(num_classes=100))
