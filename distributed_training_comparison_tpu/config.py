@@ -147,6 +147,28 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "from the per-epoch loss fetch; no extra device syncs)",
     )
     parser.add_argument(
+        "--save-last-every",
+        type=int,
+        default=1,
+        help="Write the resumable last.ckpt every N epochs (1 = every epoch)",
+    )
+    parser.add_argument(
+        "--data-mode",
+        type=str,
+        default="device",
+        choices=["device", "host"],
+        help="'device': whole split HBM-resident, scanned epochs (fastest; "
+        "CIFAR-scale). 'host': stream numpy batches per step with per-host "
+        "sharding (datasets that don't fit in HBM / multi-host loaders)",
+    )
+    parser.add_argument(
+        "--profile-dir",
+        type=str,
+        default=None,
+        help="Capture a jax.profiler trace of one steady-state epoch into "
+        "this directory (view with TensorBoard's profile plugin / Perfetto)",
+    )
+    parser.add_argument(
         "--legacy-test-stats",
         action="store_true",
         default=False,

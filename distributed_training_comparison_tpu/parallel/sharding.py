@@ -47,6 +47,24 @@ def shard_batch(batch, mesh: Mesh):
     )
 
 
+def put_replicated(tree, mesh: Mesh):
+    """Replicate a (host) pytree onto every device of the mesh.
+
+    Single-host: plain ``device_put``.  Multi-host: every process supplies
+    its identical local copy and ``make_array_from_process_local_data``
+    assembles the global replicated array (``device_put`` cannot address
+    other hosts' devices) — this is the DDP initial-weight-broadcast
+    analogue (``src/ddp/trainer.py:31``), except identical-by-construction.
+    """
+    sharding = replicated_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+    return jax.tree_util.tree_map(
+        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
+        tree,
+    )
+
+
 def host_local_batch_slice(global_batch_size: int) -> int:
     """This host's share of the global batch (reference analogue:
     ``batch_size //= ngpus_per_node``, ``src/ddp/trainer.py:34`` — but per

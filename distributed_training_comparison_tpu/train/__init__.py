@@ -17,6 +17,7 @@ compiled program on different mesh shapes.
 from .optim import configure_optimizers, step_lr_schedule
 from .state import TrainState, create_train_state
 from .step import make_train_step, make_eval_step, make_epoch_runner
+from .async_ckpt import AsyncCheckpointer
 from .checkpoint import (
     find_version_dir,
     save_checkpoint,
@@ -34,6 +35,7 @@ __all__ = [
     "make_train_step",
     "make_eval_step",
     "make_epoch_runner",
+    "AsyncCheckpointer",
     "find_version_dir",
     "save_checkpoint",
     "load_checkpoint",

@@ -1,0 +1,89 @@
+"""Write-behind checkpointing.
+
+The reference's ``save_checkpoint`` blocks the epoch loop while it
+serializes (``src/single/trainer.py:96-107``); on this framework's target
+topology the device→host fetch of the train state rides a network tunnel,
+so a synchronous save was measured at ~16 s/epoch — longer than the epoch's
+compute itself.  ``AsyncCheckpointer`` moves fetch+serialize+write to a
+single worker thread: the epoch loop hands over a *reference* to the
+on-device state and continues; the transfer overlaps the next epoch's
+compute.
+
+Correctness notes:
+- the epoch runner must NOT donate its input state buffers (the worker may
+  still be fetching them); ``make_epoch_runner`` therefore keeps donation
+  off, trading one extra state copy of HBM for full overlap;
+- ``wait()`` drains the queue — called before reading a checkpoint back
+  (test phase, end of fit) and on ``close()``;
+- writes for the same target are serialized by the single worker, so
+  ``last.ckpt`` is always a complete, most-recent snapshot.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class AsyncCheckpointer:
+    """One background writer thread executing queued checkpoint jobs.
+
+    Jobs submitted under the same ``key`` coalesce: if a newer snapshot for
+    that key is queued before the old one started writing, the old one is
+    dropped — only the most recent state of each checkpoint target ever hits
+    disk (a best.ckpt made obsolete two epochs later need not be written at
+    all, which matters when the device→host fetch is the expensive part).
+    """
+
+    def __init__(self, max_pending: int = 16) -> None:
+        self._q: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._latest: dict[str, Callable[[], object] | None] = {}
+        self._lock = threading.Lock()
+        self._errors: list[BaseException] = []
+        self._thread = threading.Thread(
+            target=self._worker, name="dtc-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            key = item
+            with self._lock:
+                job = self._latest.get(key)
+                self._latest[key] = None
+            try:
+                if job is not None:  # None => superseded, already written
+                    job()
+            except BaseException as e:  # surfaced on wait()/close()
+                self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    def submit(self, job: Callable[[], object], key: str = "default") -> None:
+        """Enqueue a checkpoint job; newer jobs with the same key supersede
+        queued-but-unstarted ones."""
+        with self._lock:
+            self._latest[key] = job
+        self._q.put(key)
+
+    def wait(self) -> None:
+        """Block until every queued job has finished; re-raise any failure."""
+        self._q.join()
+        if self._errors:
+            err = self._errors[:]
+            self._errors.clear()
+            raise RuntimeError(f"async checkpoint write failed: {err[0]!r}") from err[0]
+
+    def close(self) -> None:
+        if self._thread.is_alive():
+            self._q.put(None)
+            self._thread.join()
+        if self._errors:
+            err = self._errors[:]
+            self._errors.clear()
+            raise RuntimeError(f"async checkpoint write failed: {err[0]!r}") from err[0]

@@ -108,11 +108,13 @@ def make_train_step(
     repl = replicated_sharding(mesh)
     core = _make_step_core(precision, augment, mean, std)
 
+    # No buffer donation: the AsyncCheckpointer may still be fetching the
+    # previous state while the next step runs (see async_ckpt.py); the cost
+    # is one extra state copy of HBM.
     return jax.jit(
         core,
         in_shardings=(repl, data_shard, data_shard, repl),
         out_shardings=(repl, repl),
-        donate_argnums=(0,),
     )
 
 
@@ -196,4 +198,5 @@ def make_epoch_runner(
         state, stacked = jax.lax.scan(body, state, (perm, step_keys))
         return state, stacked  # stacked["loss"]: (steps,) per-step losses
 
-    return jax.jit(run, donate_argnums=(0,), out_shardings=(repl, repl))
+    # No donation — see make_train_step note (async checkpoint overlap).
+    return jax.jit(run, out_shardings=(repl, repl))

@@ -35,16 +35,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..data import get_datasets
+from ..data import HostLoader, get_datasets
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
 from ..models import get_model
 from ..parallel import is_main_process, make_mesh, replicated_sharding
+from ..parallel.sharding import host_local_batch_slice, put_replicated, shard_batch
 from ..utils import AverageMeter, fix_seed, setup_logger
 from ..utils.tensorboard import SummaryWriter
 from . import checkpoint as ckpt
+from .async_ckpt import AsyncCheckpointer
 from .optim import configure_optimizers
 from .state import create_train_state
-from .step import make_epoch_runner, make_eval_step
+from .step import make_epoch_runner, make_eval_step, make_train_step
 
 
 def _pad_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
@@ -83,34 +85,48 @@ class Trainer:
             hparams.model, dtype=compute_dtype
         )
 
-        # --- data (device-resident, replicated; sharding happens per-batch
-        # inside the compiled epoch via with_sharding_constraint)
+        # --- data.  'device' mode: split is HBM-resident and replicated;
+        # per-batch sharding happens inside the compiled epoch.  'host'
+        # mode: train batches stream from a per-host-sharded numpy loader
+        # (val/test stay device-resident — they are small either way).
         trn, val, tst = get_datasets(hparams)
-        repl = replicated_sharding(self.mesh)
         if len(trn) < hparams.batch_size or len(val) == 0:
             raise ValueError(
                 f"dataset too small after split: {len(trn)} train / {len(val)} "
                 f"val examples for batch size {hparams.batch_size} "
                 "(raise --limit-examples or lower --batch-size)"
             )
-        self.trn_images = jax.device_put(trn.images, repl)
-        self.trn_labels = jax.device_put(trn.labels, repl)
+        self.data_mode = getattr(hparams, "data_mode", "device")
+        if self.data_mode == "device":
+            self.trn_images, self.trn_labels = put_replicated(
+                (trn.images, trn.labels), self.mesh
+            )
+            self.train_loader = None
+        else:
+            local_batch = host_local_batch_slice(hparams.batch_size)
+            self.train_loader = HostLoader(
+                trn,
+                local_batch,
+                shuffle=True,
+                drop_last=True,
+                seed=hparams.seed,
+                num_shards=jax.process_count(),
+                shard=jax.process_index(),
+            )
         self.steps_per_epoch = trn.steps_per_epoch(hparams.batch_size, drop_last=True)
-        self._val = tuple(
-            jax.device_put(a, repl)
-            for a in _pad_batches(val.images, val.labels, hparams.batch_size)
+        self._val = put_replicated(
+            _pad_batches(val.images, val.labels, hparams.batch_size), self.mesh
         )
-        self._tst = tuple(
-            jax.device_put(a, repl)
-            for a in _pad_batches(tst.images, tst.labels, hparams.batch_size)
+        self._tst = put_replicated(
+            _pad_batches(tst.images, tst.labels, hparams.batch_size), self.mesh
         )
 
         # --- optimizer + state
         self.tx, self.lr_schedule = configure_optimizers(hparams, self.steps_per_epoch)
         init_key, self.data_key = jax.random.split(self.root_key)
-        with jax.default_device(jax.devices()[0]):
+        with jax.default_device(jax.local_devices()[0]):
             state = create_train_state(self.model, init_key, self.tx)
-        self.state = jax.device_put(state, repl)
+        self.state = put_replicated(state, self.mesh)
 
         # --- compiled programs
         test_stats = (
@@ -118,16 +134,28 @@ class Trainer:
             if getattr(hparams, "legacy_test_stats", False)
             else (CIFAR100_MEAN, CIFAR100_STD)
         )
-        self.epoch_runner = make_epoch_runner(
-            self.mesh, hparams.batch_size, precision=self.precision
-        )
+        if self.data_mode == "device":
+            self.epoch_runner = make_epoch_runner(
+                self.mesh, hparams.batch_size, precision=self.precision
+            )
+            self.train_step = None
+        else:
+            self.epoch_runner = None
+            self.train_step = make_train_step(self.mesh, precision=self.precision)
         self.eval_step = make_eval_step(self.mesh, precision=self.precision)
-        self.test_eval_step = make_eval_step(
-            self.mesh, precision=self.precision, mean=test_stats[0], std=test_stats[1]
-        )
+        if test_stats == (CIFAR100_MEAN, CIFAR100_STD):
+            self.test_eval_step = self.eval_step  # same constants, one executable
+        else:
+            self.test_eval_step = make_eval_step(
+                self.mesh,
+                precision=self.precision,
+                mean=test_stats[0],
+                std=test_stats[1],
+            )
 
         # --- run dir, logging, provenance (process-0 only)
         self.is_main = is_main_process()
+        self.ckpt_writer = AsyncCheckpointer() if self.is_main else None
         # -1 so the first validation always produces a best checkpoint, even
         # at 0.0% val accuracy (with 100 classes and a small val split that
         # is a reachable score; the reference's 0-init would then never save)
@@ -185,18 +213,24 @@ class Trainer:
             f"{self.precision}"
         )
         t_start = time.perf_counter()
+        profile_epoch = (
+            self.start_epoch + 1
+            if hp.epoch - self.start_epoch > 1
+            else self.start_epoch
+        )
         for epoch in range(self.start_epoch, hp.epoch):
+            profiling = getattr(hp, "profile_dir", None) and epoch == profile_epoch
+            if profiling:
+                jax.profiler.start_trace(hp.profile_dir)
             t0 = time.perf_counter()
-            self.state, stacked = self.epoch_runner(
-                self.state,
-                self.trn_images,
-                self.trn_labels,
-                self.data_key,
-                jnp.asarray(epoch),
-            )
-            losses = np.asarray(stacked["loss"])  # one host fetch per epoch
-            top1 = float(np.sum(np.asarray(stacked["top1_count"])))
+            if self.data_mode == "device":
+                losses, top1 = self._train_epoch_device(epoch)
+            else:
+                losses, top1 = self._train_epoch_host(epoch)
             epoch_time = time.perf_counter() - t0
+            if profiling:
+                jax.profiler.stop_trace()
+                self.logger.info(f"profiler trace written to {hp.profile_dir}")
             imgs = self.steps_per_epoch * hp.batch_size
 
             meter = AverageMeter()
@@ -227,20 +261,66 @@ class Trainer:
             self._log_tb("throughput/images_per_sec", imgs / epoch_time, epoch)
 
             if self.is_main:
+                # write-behind: the worker thread fetches + serializes while
+                # the next epoch computes (state buffers are not donated)
+                state_ref, vdir = self.state, self.version_dir
                 if val["val_acc"] > self.best_acc:
                     self.best_acc = val["val_acc"]
-                    ckpt.save_checkpoint(
-                        self.version_dir, self.state, epoch, self.best_acc
+                    self.ckpt_writer.submit(
+                        lambda s=state_ref, e=epoch, b=self.best_acc: (
+                            ckpt.save_checkpoint(vdir, s, e, b)
+                        ),
+                        key="best",
                     )
-                if getattr(hp, "save_last", True):
-                    ckpt.save_resume_state(
-                        self.version_dir, self.state, epoch, self.best_acc
+                if getattr(hp, "save_last", True) and (
+                    (epoch + 1) % getattr(hp, "save_last_every", 1) == 0
+                    or epoch == hp.epoch - 1
+                ):
+                    self.ckpt_writer.submit(
+                        lambda s=state_ref, e=epoch, b=self.best_acc: (
+                            ckpt.save_resume_state(vdir, s, e, b)
+                        ),
+                        key="last",
                     )
+        if self.ckpt_writer is not None:
+            self.ckpt_writer.wait()
         self.logger.info(
             f"[{hp.backend.upper()} Version {self.version}] done in "
             f"{time.perf_counter() - t_start:.1f}s, best val acc {self.best_acc:.2f}%"
         )
         return self.version
+
+    def _train_epoch_device(self, epoch: int) -> tuple[np.ndarray, float]:
+        """Scanned epoch over the HBM-resident split: one dispatch, one fetch."""
+        self.state, stacked = self.epoch_runner(
+            self.state,
+            self.trn_images,
+            self.trn_labels,
+            self.data_key,
+            jnp.asarray(epoch),
+        )
+        losses = np.asarray(stacked["loss"])  # one host fetch per epoch
+        top1 = float(np.sum(np.asarray(stacked["top1_count"])))
+        return losses, top1
+
+    def _train_epoch_host(self, epoch: int) -> tuple[np.ndarray, float]:
+        """Streaming epoch: per-step H2D of loader batches (the large-dataset
+        / multi-host path; reference analogue is the DataLoader loop with
+        DistributedSampler, ``src/ddp/trainer.py:143-174``)."""
+        self.train_loader.set_epoch(epoch)
+        epoch_key = jax.random.fold_in(self.data_key, epoch)
+        step_metrics = []
+        for i, (bx, by) in enumerate(self.train_loader):
+            if i >= self.steps_per_epoch:
+                break
+            batch = shard_batch({"x": bx, "y": by}, self.mesh)
+            self.state, metrics = self.train_step(
+                self.state, batch["x"], batch["y"], jax.random.fold_in(epoch_key, i)
+            )
+            step_metrics.append(metrics)  # device scalars; no per-step sync
+        losses = np.asarray([float(m["loss"]) for m in step_metrics])
+        top1 = float(sum(float(m["top1_count"]) for m in step_metrics))
+        return losses, top1
 
     # ------------------------------------------------------------------- eval
 
@@ -277,6 +357,8 @@ class Trainer:
         checkpoint from this run's version dir, mirroring the reference's
         glob-and-load phase (``src/single/main.py:22-28``)."""
         if state is None:
+            if self.ckpt_writer is not None:
+                self.ckpt_writer.wait()  # drain pending writes before reading
             best = (
                 ckpt.find_best_checkpoint(self.version_dir)
                 if self.version_dir is not None
@@ -315,5 +397,7 @@ class Trainer:
         }
 
     def close(self) -> None:
+        if self.ckpt_writer is not None:
+            self.ckpt_writer.close()
         if self.writer is not None:
             self.writer.close()
