@@ -25,6 +25,7 @@ from .sharding import (
     batch_sharding,
     replicated_sharding,
     shard_batch,
+    put_replicated,
     host_local_batch_slice,
 )
 from .dist import init_distributed, is_main_process, process_count, process_index
@@ -35,6 +36,7 @@ __all__ = [
     "batch_sharding",
     "replicated_sharding",
     "shard_batch",
+    "put_replicated",
     "host_local_batch_slice",
     "init_distributed",
     "is_main_process",
