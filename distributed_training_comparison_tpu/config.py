@@ -76,8 +76,12 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Coordinator address for jax.distributed.initialize",
     )
 
-    # training hparams (reference src/ddp/config.py:29-37)
-    parser.add_argument("--epoch", type=int, default=100)
+    # training hparams (reference src/ddp/config.py:29-37); the reference's
+    # single variant defaults to 200 epochs, dp/ddp to 100
+    # (src/single/config.py:21 vs src/ddp/config.py:29)
+    parser.add_argument(
+        "--epoch", type=int, default=200 if backend == "single" else 100
+    )
     parser.add_argument("--batch-size", type=int, default=128, help="GLOBAL batch size")
     parser.add_argument(
         "--model",

@@ -38,7 +38,7 @@ import numpy as np
 from ..data import HostLoader, get_datasets
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
 from ..models import get_model
-from ..parallel import is_main_process, make_mesh, replicated_sharding
+from ..parallel import is_main_process, make_mesh
 from ..parallel.sharding import host_local_batch_slice, put_replicated, shard_batch
 from ..utils import AverageMeter, fix_seed, setup_logger
 from ..utils.tensorboard import SummaryWriter
@@ -175,9 +175,13 @@ class Trainer:
         )
 
         if getattr(hparams, "resume", None):
-            self.state, self.start_epoch, self.best_acc = ckpt.load_resume_state(
+            state, self.start_epoch, self.best_acc = ckpt.load_resume_state(
                 hparams.resume, self.state
             )
+            # from_state_dict returns host numpy leaves; re-place them as
+            # global mesh arrays (jit on a multi-host mesh requires global
+            # jax.Arrays, not host buffers)
+            self.state = put_replicated(state, self.mesh)
             self.logger.info(
                 f"Resumed from {hparams.resume} at epoch {self.start_epoch} "
                 f"(best acc {self.best_acc:.4f})"
@@ -238,10 +242,12 @@ class Trainer:
                 gstep = epoch * self.steps_per_epoch + i
                 meter.update(float(loss))
                 if (gstep + 1) % hp.eval_step == 0:
+                    # instantaneous batch loss, like the reference's
+                    # ``loss.item()`` line (src/single/trainer.py:150-153)
                     self.logger.info(
                         f"[{hp.backend.upper()} Version {self.version} "
                         f"Epoch {epoch}] global step {gstep + 1}, "
-                        f"train loss: {meter.avg:.4f}"
+                        f"train loss: {float(loss):.4f}"
                     )
                 if getattr(hp, "log_every_step", False):
                     self._log_tb("loss/step", float(loss), gstep)
@@ -377,10 +383,9 @@ class Trainer:
                 synced = multihost_utils.broadcast_one_to_all(
                     jax.device_get((self.state.params, self.state.batch_stats))
                 )
-                repl = replicated_sharding(self.mesh)
+                params, batch_stats = put_replicated(synced, self.mesh)
                 self.state = self.state.replace(
-                    params=jax.device_put(synced[0], repl),
-                    batch_stats=jax.device_put(synced[1], repl),
+                    params=params, batch_stats=batch_stats
                 )
         else:
             self.state = state

@@ -41,6 +41,15 @@ def test_reference_launcher_flags_parse():
     assert cfg.precision == "bf16"  # --amp maps to bf16 policy
 
 
+def test_epoch_default_per_backend():
+    # reference: single defaults to 200 epochs, dp/ddp to 100
+    # (src/single/config.py:21 vs src/ddp/config.py:29)
+    assert load_config("single", argv=[]).epoch == 200
+    assert load_config("dp", argv=[]).epoch == 100
+    assert load_config("ddp", argv=[]).epoch == 100
+    assert load_config("tpu", argv=[]).epoch == 100
+
+
 def test_ddp_flags_parse():
     cfg = load_config(
         "ddp",
