@@ -1,0 +1,32 @@
+# TPU-native training image.
+#
+# Reference analogue: Dockerfile:1-23 builds on a CUDA 10.2 / cuDNN 7 base
+# because the accelerator stack lives in the container.  On Cloud TPU the
+# accelerator runtime (libtpu) is provided via the TPU VM, so a slim Python
+# base suffices; swap the jax pin for the TPU wheel when building for a TPU
+# VM (see comment below).
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends ca-certificates \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /workspace
+
+COPY requirements.txt requirements-dev.txt ./
+# CPU wheels by default (CI / laptop). On a TPU VM instead run:
+#   pip install 'jax[tpu]==0.9.0' \
+#     -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+RUN pip install --no-cache-dir -r requirements.txt
+
+COPY . .
+
+# run as a non-root user, like the reference image (Dockerfile:18-23)
+RUN useradd -m trainer && chown -R trainer /workspace
+USER trainer
+
+# 8-virtual-device CPU mesh by default so the SPMD paths run anywhere;
+# harmless on a real TPU VM (TPU devices take precedence).
+ENV XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+CMD ["sh", "src/tpu_jax/run_tpu.sh", "--synthetic-data"]

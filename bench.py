@@ -1,4 +1,4 @@
-"""Benchmark: CIFAR-100 ResNet-18 training throughput, images/sec/chip.
+"""Benchmark: CIFAR-100 ResNet training throughput, images/sec/chip + MFU.
 
 The reference never published throughput (SURVEY.md §6) — only accuracy
 tables on 2× RTX 2080 Ti.  The driver's north star asks for images/sec/chip,
@@ -6,11 +6,17 @@ so ``vs_baseline`` is measured, not assumed: the baseline leg replicates the
 reference's *loop architecture* on the same hardware — one dispatch per step,
 a host→device copy of every batch, host-side shuffling, and a per-step
 ``loss.item()`` device sync (``src/single/trainer.py:126-153``) — while the
-main leg is this framework's TPU-native path: device-resident data, in-jit
-augmentation, one ``lax.scan`` dispatch per epoch, bf16 compute.
+native legs are this framework's TPU path: device-resident data, in-jit
+augmentation, one ``lax.scan`` dispatch per epoch.
+
+Configs (BASELINE.json "configs"): rn18/bs256 bf16 (headline), rn18/bs256
+fp32, rn50/bs512 bf16.  Each native leg reports MFU = achieved training
+FLOP/s ÷ chip peak, with model FLOPs counted analytically from the
+architecture (conv MACs × 2, backward ≈ 2× forward).
 
 Output: ONE JSON line
-``{"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+"detail": {...per-config...}}``.
 """
 
 from __future__ import annotations
@@ -24,10 +30,6 @@ import numpy as np
 
 from distributed_training_comparison_tpu import models, parallel
 from distributed_training_comparison_tpu.data import synthetic_dataset
-from distributed_training_comparison_tpu.data.augment import (
-    normalize_images,
-    random_crop_flip,
-)
 from distributed_training_comparison_tpu.train import (
     configure_optimizers,
     create_train_state,
@@ -43,22 +45,95 @@ class HP:
     lr_decay_gamma = 0.1
 
 
-def _setup(mesh, precision: str):
+# ----------------------------------------------------------- analytic FLOPs
+
+_DEPTHS = {
+    "resnet18": ("basic", (2, 2, 2, 2)),
+    "resnet34": ("basic", (3, 4, 6, 3)),
+    "resnet50": ("bottleneck", (3, 4, 6, 3)),
+    "resnet101": ("bottleneck", (3, 4, 23, 3)),
+    "resnet152": ("bottleneck", (3, 8, 36, 3)),
+}
+_WIDTHS = (64, 128, 256, 512)
+_STRIDES = (1, 2, 2, 2)
+
+
+def forward_flops_per_image(name: str, num_classes: int = 100) -> float:
+    """Analytic forward FLOPs/image for the CIFAR ResNet family
+    (models/resnet.py): conv MACs × 2 on the actual feature-map sizes
+    (32×32 stem, no maxpool), + the linear head.  BN/ReLU/pool omitted
+    (<1% of conv FLOPs)."""
+    kind, depths = _DEPTHS[name]
+    exp = 1 if kind == "basic" else 4
+    hw = 32
+    macs = 3 * 3 * 3 * 64 * hw * hw  # stem
+    cin = 64
+    for planes, stride, blocks in zip(_WIDTHS, _STRIDES, depths):
+        for i in range(blocks):
+            s = stride if i == 0 else 1
+            hw_out = hw // s
+            if kind == "basic":
+                macs += 3 * 3 * cin * planes * hw_out * hw_out
+                macs += 3 * 3 * planes * planes * hw_out * hw_out
+            else:
+                macs += cin * planes * hw * hw  # 1×1 reduce (pre-stride)
+                macs += 3 * 3 * planes * planes * hw_out * hw_out
+                macs += planes * (planes * exp) * hw_out * hw_out
+            if s != 1 or cin != planes * exp:
+                macs += cin * planes * exp * hw_out * hw_out
+            cin = planes * exp
+            hw = hw_out
+    macs += cin * num_classes
+    return 2.0 * macs
+
+
+def train_flops_per_image(name: str) -> float:
+    """fwd + bwd ≈ 3× fwd (standard estimate: grad-wrt-input + grad-wrt-
+    weights each cost ≈ one forward)."""
+    return 3.0 * forward_flops_per_image(name)
+
+
+# per-chip peak dense-matmul FLOP/s (bf16), by jax device_kind
+_PEAK_FLOPS = {
+    "TPU v3": 123e12 / 2,  # per chip = 2 cores × ~61.5 TF... jax exposes cores
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def chip_peak_flops() -> float | None:
+    kind = jax.devices()[0].device_kind
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+# ----------------------------------------------------------------- harness
+
+
+def _setup(mesh, model_name: str, precision: str):
     model = models.get_model(
-        "resnet18", dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32
+        model_name, dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32
     )
     tx, _ = configure_optimizers(HP, steps_per_epoch=100)
     state = create_train_state(model, jax.random.key(0), tx)
     return jax.device_put(state, parallel.replicated_sharding(mesh))
 
 
-def bench_native(mesh, images, labels, batch_size: int, epochs: int) -> float:
-    """TPU-native leg: scanned epoch over the HBM-resident split, bf16."""
-    state = _setup(mesh, "bf16")
+def bench_native(
+    mesh, images, labels, model_name: str, precision: str, batch_size: int, epochs: int
+) -> float:
+    """Native leg: scanned epoch over the HBM-resident split."""
+    state = _setup(mesh, model_name, precision)
     repl = parallel.replicated_sharding(mesh)
     d_images = jax.device_put(images, repl)
     d_labels = jax.device_put(labels, repl)
-    runner = make_epoch_runner(mesh, batch_size, precision="bf16")
+    runner = make_epoch_runner(mesh, batch_size, precision=precision)
     key = jax.random.key(1)
     steps = len(images) // batch_size
 
@@ -78,7 +153,7 @@ def bench_reference_style(mesh, images, labels, batch_size: int, steps: int) -> 
     """Baseline leg: the reference's loop shape — python per-step loop,
     host-side shuffle + aug dispatch, H2D copy per batch, fp32, and a
     device→host loss fetch every step."""
-    state = _setup(mesh, "fp32")
+    state = _setup(mesh, "resnet18", "fp32")
     step_fn = make_train_step(mesh, precision="fp32", augment=True)
     shard = parallel.batch_sharding(mesh)
     n = len(images)
@@ -104,29 +179,60 @@ def main() -> None:
     platform = jax.devices()[0].platform
     mesh = parallel.make_mesh(backend="tpu")
     n_chips = mesh.shape["data"] * mesh.shape["model"]
+    peak = chip_peak_flops()
 
     if platform == "cpu":  # CI smoke sizing
-        n, batch, epochs, ref_steps = 2_048, 128, 1, 4
+        n, epochs, ref_steps = 2_048, 1, 4
+        configs = [("resnet18", "bf16", 128)]
     else:
-        n, batch, epochs, ref_steps = 45_056, 256, 3, 60
+        n, epochs, ref_steps = 45_056, 3, 60
+        configs = [
+            ("resnet18", "bf16", 256),  # headline (north-star config)
+            ("resnet18", "fp32", 256),
+            ("resnet50", "bf16", 512),
+        ]
 
     images, labels = synthetic_dataset(n, num_classes=100, seed=0)
 
-    native = bench_native(mesh, images, labels, batch, epochs)
-    ref_style = bench_reference_style(mesh, images, labels, batch, ref_steps)
+    per_config = {}
+    for model_name, precision, batch in configs:
+        ips = bench_native(mesh, images, labels, model_name, precision, batch, epochs)
+        ips_chip = ips / n_chips
+        flops = train_flops_per_image(model_name)
+        # MFU only for bf16 legs: _PEAK_FLOPS is the bf16 dense-matmul peak;
+        # fp32 peak differs per TPU generation, so a bf16-peak ratio would
+        # not be a real utilization figure for the fp32 config
+        mfu = (
+            round(ips_chip * flops / peak, 4)
+            if peak and precision == "bf16"
+            else None
+        )
+        per_config[f"{model_name}_{precision}_bs{batch}"] = {
+            "images_per_sec_per_chip": round(ips_chip, 1),
+            "train_flops_per_image": round(flops / 1e9, 3),  # GFLOPs
+            "achieved_tflops": round(ips_chip * flops / 1e12, 2),
+            "mfu": mfu,
+        }
+
+    headline_key = next(iter(per_config))
+    headline = per_config[headline_key]["images_per_sec_per_chip"]
+    ref_style = bench_reference_style(
+        mesh, images, labels, configs[0][2], ref_steps
+    )
 
     print(
         json.dumps(
             {
                 "metric": "cifar100_resnet18_train_throughput",
-                "value": round(native / n_chips, 1),
+                "value": headline,
                 "unit": "images/sec/chip",
-                "vs_baseline": round(native / ref_style, 3),
+                "vs_baseline": round(headline * n_chips / ref_style, 3),
                 "detail": {
                     "platform": platform,
+                    "device_kind": jax.devices()[0].device_kind,
                     "chips": n_chips,
-                    "global_batch": batch,
-                    "native_images_per_sec": round(native, 1),
+                    "chip_peak_bf16_tflops": round(peak / 1e12, 1) if peak else None,
+                    "configs": per_config,
                     "reference_style_images_per_sec": round(ref_style, 1),
                     "baseline_definition": "same chip, reference loop shape: "
                     "per-step dispatch + H2D copy + per-step host sync, fp32",

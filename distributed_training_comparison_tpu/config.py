@@ -117,6 +117,24 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Compute precision; overrides --amp when set",
     )
     parser.add_argument(
+        "--progress",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="tqdm progress bars (epoch bar always; step bar in host data "
+        "mode), process-0 only — reference shows bars on every variant "
+        "(src/single/trainer.py:126-130)",
+    )
+    parser.add_argument(
+        "--bn-dtype",
+        type=str,
+        default="fp32",
+        choices=["fp32", "compute"],
+        help="Dtype BatchNorm reduces batch statistics in. 'fp32' (default) "
+        "keeps mean/var reduction full-precision even under the bf16 policy "
+        "— low-precision stat reduction is an accuracy risk; 'compute' "
+        "reduces in the activation dtype",
+    )
+    parser.add_argument(
         "--synthetic-data",
         action="store_true",
         default=False,
@@ -155,6 +173,15 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         type=int,
         default=1,
         help="Write the resumable last.ckpt every N epochs (1 = every epoch)",
+    )
+    parser.add_argument(
+        "--save-last-min-secs",
+        type=float,
+        default=20.0,
+        help="Throttle resumable-state saves to at most one per this many "
+        "seconds (the device→host fetch of the full train state can cost "
+        "more than a fast epoch's compute; the final epoch always saves). "
+        "0 disables the throttle",
     )
     parser.add_argument(
         "--data-mode",

@@ -35,6 +35,7 @@ from .sampler import train_val_split, shard_indices, epoch_permutation
 from .loader import (
     DeviceDataset,
     HostLoader,
+    PrefetchLoader,
     get_datasets,
     get_trn_val_loader,
     get_tst_loader,
@@ -54,6 +55,7 @@ __all__ = [
     "epoch_permutation",
     "DeviceDataset",
     "HostLoader",
+    "PrefetchLoader",
     "get_datasets",
     "get_trn_val_loader",
     "get_tst_loader",

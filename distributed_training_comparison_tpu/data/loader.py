@@ -17,6 +17,8 @@ Two consumption modes:
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from typing import Iterator
 
 import numpy as np
@@ -134,6 +136,77 @@ class HostLoader:
         for start in range(0, end, self.batch_size):
             b = idx[start : start + self.batch_size]
             yield self.dataset.images[b], self.dataset.labels[b]
+
+
+class PrefetchLoader:
+    """Background-thread prefetch around any epoch-aware batch iterator.
+
+    The reference's ``DataLoader(num_workers=4)`` (``src/single/dataset.py``)
+    overlaps host-side batch assembly with device compute via worker
+    processes; here one producer thread fills a bounded queue ``depth``
+    batches ahead (numpy slicing releases the GIL, so a thread suffices —
+    and unlike the per-step synchronous round-1 loader, the accelerator
+    never waits on batch assembly).
+
+    Yields exactly the wrapped loader's sequence — same order, same
+    determinism — and re-raises any producer exception at the consumer.
+    """
+
+    _DONE = object()
+
+    def __init__(self, loader, depth: int = 2) -> None:
+        self.loader = loader
+        self.depth = max(1, depth)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            try:
+                for item in self.loader:
+                    if not _put(item):
+                        return
+                _put(self._DONE)
+            except BaseException as e:  # surface producer errors, don't hang
+                _put(e)
+
+        thread = threading.Thread(target=produce, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # consumer may abandon mid-epoch (steps_per_epoch break, error):
+            # signal the producer and drain so it never blocks forever
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            thread.join(timeout=5.0)
 
 
 def get_trn_val_loader(

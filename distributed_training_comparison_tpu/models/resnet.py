@@ -19,6 +19,11 @@ TPU-native choices (deliberately NOT a torch translation):
 - ``dtype`` threads a bfloat16 *compute* policy through every layer while
   parameters and BatchNorm statistics stay float32 (replaces CUDA-AMP
   autocast + GradScaler, ``src/single/trainer.py:135-140``).
+- ``norm_dtype`` controls the dtype BatchNorm *reduces statistics in* —
+  float32 by default even under the bf16 policy, because low-precision
+  mean/var reduction is a known accuracy risk at 50-epoch scale (SURVEY.md
+  §7); pass ``norm_dtype=None`` to reduce in the compute dtype instead
+  (the round-1 behavior, kept as a measurable comparison point).
 - BatchNorm reduces over the batch axis of the **global** array: under
   ``jit`` over a device mesh with the batch sharded on the data axis, XLA
   turns the mean/variance into cross-replica reductions — i.e. SyncBatchNorm
@@ -61,6 +66,7 @@ class BasicBlock(nn.Module):
     planes: int
     stride: int = 1
     dtype: Any = jnp.float32
+    norm_dtype: Any = jnp.float32
 
     expansion: int = 1
 
@@ -71,7 +77,7 @@ class BasicBlock(nn.Module):
             use_running_average=not train,
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
-            dtype=self.dtype,
+            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
         )
         out = Conv3x3(self.planes, strides=self.stride, dtype=self.dtype)(x)
         out = norm()(out)
@@ -94,6 +100,7 @@ class Bottleneck(nn.Module):
     planes: int
     stride: int = 1
     dtype: Any = jnp.float32
+    norm_dtype: Any = jnp.float32
 
     expansion: int = 4
 
@@ -104,7 +111,7 @@ class Bottleneck(nn.Module):
             use_running_average=not train,
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
-            dtype=self.dtype,
+            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
         )
         out = Conv1x1(self.planes, strides=1, dtype=self.dtype)(x)
         out = norm()(out)
@@ -131,6 +138,7 @@ class ResNet(nn.Module):
     num_blocks: Sequence[int]
     num_classes: int = 100
     dtype: Any = jnp.float32
+    norm_dtype: Any = jnp.float32
 
     STAGE_WIDTHS = (64, 128, 256, 512)
     STAGE_STRIDES = (1, 2, 2, 2)
@@ -143,7 +151,7 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
-            dtype=self.dtype,
+            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
             name="stem_bn",
         )(x)
         x = nn.relu(x)
@@ -155,6 +163,7 @@ class ResNet(nn.Module):
                     planes=planes,
                     stride=stride if i == 0 else 1,
                     dtype=self.dtype,
+                    norm_dtype=self.norm_dtype,
                     name=f"stage{stage + 1}_block{i}",
                 )(x, train=train)
         # 4×4 avg_pool on a 4×4 feature map == spatial mean (net.py:113)

@@ -26,7 +26,13 @@ from .sharding import (
     replicated_sharding,
     shard_batch,
     put_replicated,
+    place_tree,
     host_local_batch_slice,
+)
+from .tp import (
+    batch_stats_partition_specs,
+    param_partition_specs,
+    state_shardings,
 )
 from .dist import init_distributed, is_main_process, process_count, process_index
 
@@ -37,7 +43,10 @@ __all__ = [
     "replicated_sharding",
     "shard_batch",
     "put_replicated",
-    "host_local_batch_slice",
+    "place_tree",
+    "param_partition_specs",
+    "batch_stats_partition_specs",
+    "state_shardings",
     "init_distributed",
     "is_main_process",
     "process_count",

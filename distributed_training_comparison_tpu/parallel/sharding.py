@@ -51,18 +51,53 @@ def put_replicated(tree, mesh: Mesh):
     """Replicate a (host) pytree onto every device of the mesh.
 
     Single-host: plain ``device_put``.  Multi-host: every process supplies
-    its identical local copy and ``make_array_from_process_local_data``
-    assembles the global replicated array (``device_put`` cannot address
-    other hosts' devices) — this is the DDP initial-weight-broadcast
-    analogue (``src/ddp/trainer.py:31``), except identical-by-construction.
+    its identical local copy and the global replicated array is assembled
+    from per-device shards (``device_put`` cannot address other hosts'
+    devices) — this is the DDP initial-weight-broadcast analogue
+    (``src/ddp/trainer.py:31``), except identical-by-construction.
     """
     sharding = replicated_sharding(mesh)
+    return place_tree(tree, jax.tree_util.tree_map(lambda _: sharding, tree))
+
+
+def place_tree(tree, shardings):
+    """Place a host pytree according to a matching pytree of shardings.
+
+    The general form of ``put_replicated`` that also handles partitioned
+    specs (tensor-parallel params, sharded optimizer state).  Multi-host:
+    every process holds the full host value and contributes the shards its
+    local devices own via ``make_array_from_callback`` — valid for ANY
+    sharding, unlike ``device_put``/``make_array_from_process_local_data``.
+    """
     if jax.process_count() == 1:
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
-    return jax.tree_util.tree_map(
-        lambda x: jax.make_array_from_process_local_data(sharding, np.asarray(x)),
-        tree,
-    )
+        return jax.tree_util.tree_map(jax.device_put, tree, shardings)
+
+    def place(x, sh):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    return jax.tree_util.tree_map(place, tree, shardings)
+
+
+def fetch_to_host(tree):
+    """Fetch a pytree of (possibly sharded, possibly multi-host) jax.Arrays
+    to host numpy.
+
+    ``jax.device_get`` alone raises on arrays with non-addressable shards —
+    e.g. tensor-parallel params whose ``model`` axis spans hosts.  Such
+    leaves are all-gathered across processes first; fully-addressable leaves
+    (replicated or single-host) take the direct path.  Used by checkpointing
+    and the test-phase broadcast, which must see the *global* value.
+    """
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(fetch, tree)
 
 
 def host_local_batch_slice(global_batch_size: int) -> int:

@@ -1,0 +1,163 @@
+"""Tensor parallelism: parameter partition specs over the ``"model"`` axis.
+
+The reference has no tensor parallelism at all (SURVEY.md §2.2 — verified,
+no model sharding anywhere in ``src/``); this module is a beyond-parity
+capability.  Layout is Megatron-style column→row pairing, expressed purely
+as ``PartitionSpec``s on parameters — GSPMD propagates activation shardings
+and inserts the ICI collectives (the hand-written all-reduces of a
+CUDA/NCCL tensor-parallel implementation do not exist here):
+
+- In each residual block of stages 3 and 4 (the wide layers, where the
+  parameters are), one conv is **column-parallel** (output channels sharded;
+  its BatchNorm scale/bias/stats shard with the channels) and the following
+  conv is **row-parallel** (input channels sharded, output replicated — XLA
+  emits the psum).  BasicBlock: Conv_0 col / Conv_1 row.  Bottleneck:
+  Conv_1 col / Conv_2 row.  Shortcut convs and block outputs stay
+  replicated, so the residual add never needs a reshard.
+- The classifier head is column-parallel over classes.
+- Everything else (stem, stages 1-2, biases of replicated layers) is
+  replicated.
+
+With ``model`` axis size 1 every spec degenerates to fully-replicated, so
+one placement code path serves the single / dp / ddp parity configs and the
+tensor-parallel extension alike.
+
+``state_shardings`` maps the layout over a whole ``TrainState``: the SGD
+momentum ``trace`` mirrors the param tree (matched by key-path suffix), BN
+``batch_stats`` mirror their BatchNorm's scale/bias, scalars (``step``, LR
+schedule counts) are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+# module name prefixes whose blocks are tensor-parallelized
+_TP_STAGES = ("stage3_", "stage4_")
+
+_REPL = P()
+
+
+def _block_specs(block_params: dict[str, Any]) -> dict[str, Any]:
+    """Partition specs for one residual block's param subtree.
+
+    Keys are flax auto-names: ``Conv_k`` / ``BatchNorm_k`` in definition
+    order (models/resnet.py): BasicBlock = conv3x3, conv3x3 [, shortcut];
+    Bottleneck = conv1x1, conv3x3, conv1x1 [, shortcut].  The block kind is
+    identified by Conv_0's spatial shape (3×3 → BasicBlock, 1×1 →
+    Bottleneck) so the same rule covers every depth of the zoo.
+    """
+    kernel0 = block_params["Conv_0"]["kernel"]
+    is_bottleneck = kernel0.shape[0] == 1
+    col_conv, row_conv = ("Conv_1", "Conv_2") if is_bottleneck else ("Conv_0", "Conv_1")
+    col_bn = "BatchNorm_1" if is_bottleneck else "BatchNorm_0"
+
+    specs: dict[str, Any] = {}
+    for name, sub in block_params.items():
+        if name == col_conv:
+            specs[name] = {"kernel": P(None, None, None, MODEL_AXIS)}
+        elif name == row_conv:
+            specs[name] = {"kernel": P(None, None, MODEL_AXIS, None)}
+        elif name == col_bn:
+            specs[name] = {k: P(MODEL_AXIS) for k in sub}
+        else:  # shortcut conv/BN, the non-sharded BN(s): replicated
+            specs[name] = jax.tree_util.tree_map(lambda _: _REPL, sub)
+    return specs
+
+
+def param_partition_specs(params: dict[str, Any]) -> dict[str, Any]:
+    """Params-shaped tree of ``PartitionSpec``s implementing the TP layout."""
+    specs: dict[str, Any] = {}
+    for mod, sub in params.items():
+        if mod == "head":
+            specs[mod] = {"kernel": P(None, MODEL_AXIS), "bias": P(MODEL_AXIS)}
+        elif mod.startswith(_TP_STAGES):
+            specs[mod] = _block_specs(sub)
+        else:
+            specs[mod] = jax.tree_util.tree_map(lambda _: _REPL, sub)
+    return specs
+
+
+def batch_stats_partition_specs(
+    params: dict[str, Any], batch_stats: dict[str, Any]
+) -> dict[str, Any]:
+    """BN running mean/var shard exactly like their BatchNorm's scale/bias.
+
+    Block structure (BasicBlock vs Bottleneck) is only identifiable from
+    conv kernel shapes, so specs are derived from ``params`` and projected
+    onto the ``batch_stats`` tree (same module paths, leaves mean/var).
+    """
+    pspecs = param_partition_specs(params)
+
+    def project(mod_specs, mod_stats):
+        out = {}
+        for bn_name, stats in mod_stats.items():  # {"mean": ..., "var": ...}
+            bn_spec = mod_specs.get(bn_name, {})
+            # scale/bias/mean/var are all per-channel → share one spec
+            leaf_spec = next(iter(bn_spec.values())) if bn_spec else _REPL
+            out[bn_name] = {k: leaf_spec for k in stats}
+        return out
+
+    return {
+        mod: (
+            project(pspecs[mod], sub)
+            if mod.startswith(_TP_STAGES)
+            # top-level BatchNorms (stem_bn) have bare {mean, var} leaves,
+            # not BN-submodule nesting; everything outside the TP stages is
+            # replicated anyway
+            else jax.tree_util.tree_map(lambda _: _REPL, sub)
+        )
+        for mod, sub in batch_stats.items()
+    }
+
+
+def _key_names(key_path) -> tuple[str, ...]:
+    names = []
+    for k in key_path:
+        if hasattr(k, "key"):  # DictKey
+            names.append(str(k.key))
+        elif hasattr(k, "name"):  # GetAttrKey
+            names.append(str(k.name))
+    return tuple(names)
+
+
+def state_shardings(mesh: Mesh, state):
+    """A ``TrainState``-shaped pytree of ``NamedSharding``s for the TP layout.
+
+    Works for any mesh: with ``model`` axis size 1 all specs are effectively
+    replicated (the parity configs); with ``model`` > 1 stage-3/4 and the
+    head are genuinely partitioned.  Optimizer-state leaves (the momentum
+    ``trace`` mirrors params) are matched by key-path suffix against the
+    param tree so the layout needs no knowledge of optax's state structure.
+    """
+    pspecs = param_partition_specs(state.params)
+    bspecs = batch_stats_partition_specs(state.params, state.batch_stats)
+
+    suffix_map: dict[tuple[str, ...], P] = {}
+    for kp, spec in jax.tree_util.tree_flatten_with_path(pspecs)[0]:
+        suffix_map[_key_names(kp)] = spec
+
+    def opt_leaf_spec(key_path, _leaf) -> P:
+        names = _key_names(key_path)
+        for start in range(len(names)):
+            hit = suffix_map.get(names[start:])
+            if hit is not None:
+                return hit
+        return _REPL
+
+    def ns(spec_tree):
+        return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), spec_tree)
+
+    return state.replace(
+        step=NamedSharding(mesh, _REPL),
+        params=ns(pspecs),
+        batch_stats=ns(bspecs),
+        opt_state=ns(
+            jax.tree_util.tree_map_with_path(opt_leaf_spec, state.opt_state)
+        ),
+    )

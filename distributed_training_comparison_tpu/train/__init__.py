@@ -16,7 +16,7 @@ compiled program on different mesh shapes.
 
 from .optim import configure_optimizers, step_lr_schedule
 from .state import TrainState, create_train_state
-from .step import make_train_step, make_eval_step, make_epoch_runner
+from .step import make_train_step, make_eval_step, make_eval_runner, make_epoch_runner
 from .async_ckpt import AsyncCheckpointer
 from .checkpoint import (
     find_version_dir,
@@ -34,6 +34,7 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_eval_step",
+    "make_eval_runner",
     "make_epoch_runner",
     "AsyncCheckpointer",
     "find_version_dir",

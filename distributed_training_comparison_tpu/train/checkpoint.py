@@ -20,10 +20,9 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Any
 
-import jax
-import numpy as np
 from flax import serialization
 
+from ..parallel.sharding import fetch_to_host
 from .state import TrainState
 
 BEST_PREFIX = "best_model_"
@@ -53,7 +52,9 @@ def _state_dict(state: TrainState) -> dict[str, Any]:
 
 
 def _to_host(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)), tree)
+    # shard-safe: tensor-parallel leaves spanning hosts are all-gathered
+    # (plain device_get raises on non-addressable shards)
+    return fetch_to_host(tree)
 
 
 def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_acc: float) -> Path:

@@ -97,15 +97,21 @@ def make_train_step(
     augment: bool = True,
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
+    state_sharding=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """Build the compiled ``(state, images_u8, labels, key) -> (state, metrics)``.
 
     ``images_u8`` is the raw uint8 global batch (augmentation and
     normalization are fused into the compiled step); metrics are on-device
     scalars (no implicit host sync).
+
+    ``state_sharding`` — a ``TrainState``-shaped pytree of shardings (see
+    ``parallel.state_shardings``) pinning the tensor-parallel layout; when
+    ``None`` the state is fully replicated (pure data parallelism).
     """
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(precision, augment, mean, std)
 
     # No buffer donation: the AsyncCheckpointer may still be fetching the
@@ -113,30 +119,18 @@ def make_train_step(
     # is one extra state copy of HBM.
     return jax.jit(
         core,
-        in_shardings=(repl, data_shard, data_shard, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data_shard, data_shard, repl),
+        out_shardings=(state_sh, repl),
     )
 
 
-def make_eval_step(
-    mesh: Mesh,
-    *,
-    precision: str = "fp32",
-    mean=CIFAR100_MEAN,
-    std=CIFAR100_STD,
-) -> Callable[..., Metrics]:
-    """Compiled eval step with padding mask.
-
-    ``weights`` (1.0 real / 0.0 pad) lets fixed-shape batches cover a split
-    whose size doesn't divide the batch — every example counted exactly once
-    (the reference instead drops or double-counts under ddp sharding,
-    SURVEY.md §5 quirk 1).
-    """
+def _make_eval_core(mesh: Mesh, precision: str, mean, std):
+    """Per-batch eval metrics fn shared by the one-shot step and the scanned
+    runner (so the two can never diverge)."""
     compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
     data_shard = batch_sharding(mesh)
-    repl = replicated_sharding(mesh)
 
-    def step(state: TrainState, images, labels, weights) -> Metrics:
+    def core(state: TrainState, images, labels, weights) -> Metrics:
         # reshard in-program so callers can pass slices of a replicated
         # device-resident split as well as pre-sharded batches
         images = jax.lax.with_sharding_constraint(images, data_shard)
@@ -157,7 +151,64 @@ def make_eval_step(
             "count": weights.sum(),
         }
 
-    return jax.jit(step, out_shardings=repl)
+    return core
+
+
+def make_eval_step(
+    mesh: Mesh,
+    *,
+    precision: str = "fp32",
+    mean=CIFAR100_MEAN,
+    std=CIFAR100_STD,
+) -> Callable[..., Metrics]:
+    """Compiled eval step with padding mask.
+
+    ``weights`` (1.0 real / 0.0 pad) lets fixed-shape batches cover a split
+    whose size doesn't divide the batch — every example counted exactly once
+    (the reference instead drops or double-counts under ddp sharding,
+    SURVEY.md §5 quirk 1).
+    """
+    repl = replicated_sharding(mesh)
+    core = _make_eval_core(mesh, precision, mean, std)
+    return jax.jit(core, out_shardings=repl)
+
+
+def make_eval_runner(
+    mesh: Mesh,
+    batch_size: int,
+    *,
+    precision: str = "fp32",
+    mean=CIFAR100_MEAN,
+    std=CIFAR100_STD,
+) -> Callable[..., Metrics]:
+    """A whole eval split as ONE compiled ``lax.scan`` over padded batches.
+
+    Mirrors the train path's one-dispatch-per-epoch design: the reference
+    (and the round-1 ``_run_eval``) dispatches per batch — 79 dispatches per
+    CIFAR-100 test pass; this is a single device program returning the four
+    reduction totals.  One executable per split shape (val/test differ).
+    """
+    repl = replicated_sharding(mesh)
+    core = _make_eval_core(mesh, precision, mean, std)
+
+    def run(state: TrainState, images, labels, weights) -> Metrics:
+        nb = images.shape[0] // batch_size
+        bshape = lambda a: a.reshape(nb, batch_size, *a.shape[1:])  # noqa: E731
+
+        def body(totals, batch):
+            m = core(state, *batch)
+            return {k: totals[k] + m[k] for k in totals}, None
+
+        zeros = {
+            k: jnp.zeros((), jnp.float32)
+            for k in ("loss_sum", "top1_count", "top5_count", "count")
+        }
+        totals, _ = jax.lax.scan(
+            body, zeros, (bshape(images), bshape(labels), bshape(weights))
+        )
+        return totals
+
+    return jax.jit(run, out_shardings=repl)
 
 
 def make_epoch_runner(
@@ -168,6 +219,7 @@ def make_epoch_runner(
     augment: bool = True,
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
+    state_sharding=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -179,6 +231,7 @@ def make_epoch_runner(
     """
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(precision, augment, mean, std)
 
     def run(state: TrainState, images, labels, key: jax.Array, epoch):
@@ -199,4 +252,4 @@ def make_epoch_runner(
         return state, stacked  # stacked["loss"]: (steps,) per-step losses
 
     # No donation — see make_train_step note (async checkpoint overlap).
-    return jax.jit(run, out_shardings=(repl, repl))
+    return jax.jit(run, out_shardings=(state_sh, repl))

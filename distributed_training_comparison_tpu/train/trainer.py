@@ -35,18 +35,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..data import HostLoader, get_datasets
+from ..data import HostLoader, PrefetchLoader, get_datasets
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
 from ..models import get_model
-from ..parallel import is_main_process, make_mesh
-from ..parallel.sharding import host_local_batch_slice, put_replicated, shard_batch
+from ..parallel import is_main_process, make_mesh, state_shardings
+from ..parallel.sharding import (
+    fetch_to_host,
+    host_local_batch_slice,
+    place_tree,
+    put_replicated,
+    shard_batch,
+)
 from ..utils import AverageMeter, fix_seed, setup_logger
 from ..utils.tensorboard import SummaryWriter
 from . import checkpoint as ckpt
 from .async_ckpt import AsyncCheckpointer
 from .optim import configure_optimizers
 from .state import create_train_state
-from .step import make_epoch_runner, make_eval_step, make_train_step
+from .step import make_epoch_runner, make_eval_runner, make_train_step
 
 
 def _pad_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
@@ -81,8 +87,13 @@ class Trainer:
         self.root_key = fix_seed(hparams.seed)
         self.precision = hparams.precision
         compute_dtype = jnp.bfloat16 if self.precision == "bf16" else jnp.float32
+        norm_dtype = (
+            compute_dtype
+            if getattr(hparams, "bn_dtype", "fp32") == "compute"
+            else jnp.float32
+        )
         self.model = model if model is not None else get_model(
-            hparams.model, dtype=compute_dtype
+            hparams.model, dtype=compute_dtype, norm_dtype=norm_dtype
         )
 
         # --- data.  'device' mode: split is HBM-resident and replicated;
@@ -104,7 +115,7 @@ class Trainer:
             self.train_loader = None
         else:
             local_batch = host_local_batch_slice(hparams.batch_size)
-            self.train_loader = HostLoader(
+            base_loader = HostLoader(
                 trn,
                 local_batch,
                 shuffle=True,
@@ -112,6 +123,15 @@ class Trainer:
                 seed=hparams.seed,
                 num_shards=jax.process_count(),
                 shard=jax.process_index(),
+            )
+            # --workers (reference DataLoader num_workers) sets the prefetch
+            # depth; 0 means synchronous batch assembly, like the
+            # reference's num_workers=0
+            workers = getattr(hparams, "workers", 2)
+            self.train_loader = (
+                PrefetchLoader(base_loader, depth=workers)
+                if workers > 0
+                else base_loader
             )
         self.steps_per_epoch = trn.steps_per_epoch(hparams.batch_size, drop_last=True)
         self._val = put_replicated(
@@ -126,7 +146,10 @@ class Trainer:
         init_key, self.data_key = jax.random.split(self.root_key)
         with jax.default_device(jax.local_devices()[0]):
             state = create_train_state(self.model, init_key, self.tx)
-        self.state = put_replicated(state, self.mesh)
+        # TP layout over the "model" axis (degenerates to replicated when
+        # model_parallel == 1, so one placement path serves every variant)
+        self.state_sharding = state_shardings(self.mesh, state)
+        self.state = place_tree(state, self.state_sharding)
 
         # --- compiled programs
         test_stats = (
@@ -136,18 +159,31 @@ class Trainer:
         )
         if self.data_mode == "device":
             self.epoch_runner = make_epoch_runner(
-                self.mesh, hparams.batch_size, precision=self.precision
+                self.mesh,
+                hparams.batch_size,
+                precision=self.precision,
+                state_sharding=self.state_sharding,
             )
             self.train_step = None
         else:
             self.epoch_runner = None
-            self.train_step = make_train_step(self.mesh, precision=self.precision)
-        self.eval_step = make_eval_step(self.mesh, precision=self.precision)
-        if test_stats == (CIFAR100_MEAN, CIFAR100_STD):
-            self.test_eval_step = self.eval_step  # same constants, one executable
-        else:
-            self.test_eval_step = make_eval_step(
+            self.train_step = make_train_step(
                 self.mesh,
+                precision=self.precision,
+                state_sharding=self.state_sharding,
+            )
+        # whole-split scanned eval: one dispatch per validate()/test() call
+        # (one executable per split shape), matching the train path's
+        # one-dispatch-per-epoch design
+        self.eval_runner = make_eval_runner(
+            self.mesh, hparams.batch_size, precision=self.precision
+        )
+        if test_stats == (CIFAR100_MEAN, CIFAR100_STD):
+            self.test_eval_runner = self.eval_runner  # same constants
+        else:
+            self.test_eval_runner = make_eval_runner(
+                self.mesh,
+                hparams.batch_size,
                 precision=self.precision,
                 mean=test_stats[0],
                 std=test_stats[1],
@@ -156,6 +192,7 @@ class Trainer:
         # --- run dir, logging, provenance (process-0 only)
         self.is_main = is_main_process()
         self.ckpt_writer = AsyncCheckpointer() if self.is_main else None
+        self._last_resume_save = float("-inf")
         # -1 so the first validation always produces a best checkpoint, even
         # at 0.0% val accuracy (with 100 classes and a small val split that
         # is a reachable score; the reference's 0-init would then never save)
@@ -179,9 +216,9 @@ class Trainer:
                 hparams.resume, self.state
             )
             # from_state_dict returns host numpy leaves; re-place them as
-            # global mesh arrays (jit on a multi-host mesh requires global
-            # jax.Arrays, not host buffers)
-            self.state = put_replicated(state, self.mesh)
+            # global mesh arrays with the run's layout (jit on a multi-host
+            # mesh requires global jax.Arrays, not host buffers)
+            self.state = place_tree(state, self.state_sharding)
             self.logger.info(
                 f"Resumed from {hparams.resume} at epoch {self.start_epoch} "
                 f"(best acc {self.best_acc:.4f})"
@@ -204,6 +241,19 @@ class Trainer:
         if self.writer is not None:
             self.writer.add_scalar(tag, value, step)
 
+    def _progress_bar(self, iterable, desc: str):
+        """tqdm wrapper, process-0 only (the reference shows bars on every
+        variant, ``src/single/trainer.py:126-130`` — with rank-gating quirks
+        under ddp, SURVEY.md §5 quirk 2, fixed here: bars on process 0
+        everywhere).  Returns None when disabled/unavailable."""
+        if not getattr(self.hparams, "progress", False) or not self.is_main:
+            return None
+        try:
+            from tqdm import tqdm
+        except ImportError:
+            return None
+        return tqdm(iterable, desc=desc, leave=False)
+
     # ------------------------------------------------------------------ train
 
     def fit(self) -> int:
@@ -222,7 +272,9 @@ class Trainer:
             if hp.epoch - self.start_epoch > 1
             else self.start_epoch
         )
-        for epoch in range(self.start_epoch, hp.epoch):
+        epochs = range(self.start_epoch, hp.epoch)
+        bar = self._progress_bar(epochs, desc="epochs")
+        for epoch in bar if bar is not None else epochs:
             profiling = getattr(hp, "profile_dir", None) and epoch == profile_epoch
             if profiling:
                 jax.profiler.start_trace(hp.profile_dir)
@@ -278,10 +330,19 @@ class Trainer:
                         ),
                         key="best",
                     )
+                is_last_epoch = epoch == hp.epoch - 1
+                due = (epoch + 1) % getattr(hp, "save_last_every", 1) == 0
+                # throttle: the full-state device→host fetch can exceed a
+                # fast epoch's compute time; cap the save rate (final epoch
+                # always saves so resume never loses the finished state)
+                min_secs = getattr(hp, "save_last_min_secs", 0.0) or 0.0
+                throttled = (
+                    time.monotonic() - self._last_resume_save < min_secs
+                )
                 if getattr(hp, "save_last", True) and (
-                    (epoch + 1) % getattr(hp, "save_last_every", 1) == 0
-                    or epoch == hp.epoch - 1
+                    is_last_epoch or (due and not throttled)
                 ):
+                    self._last_resume_save = time.monotonic()
                     self.ckpt_writer.submit(
                         lambda s=state_ref, e=epoch, b=self.best_acc: (
                             ckpt.save_resume_state(vdir, s, e, b)
@@ -316,7 +377,9 @@ class Trainer:
         self.train_loader.set_epoch(epoch)
         epoch_key = jax.random.fold_in(self.data_key, epoch)
         step_metrics = []
-        for i, (bx, by) in enumerate(self.train_loader):
+        loader = self.train_loader
+        bar = self._progress_bar(loader, desc=f"epoch {epoch}")
+        for i, (bx, by) in enumerate(bar if bar is not None else loader):
             if i >= self.steps_per_epoch:
                 break
             batch = shard_batch({"x": bx, "y": by}, self.mesh)
@@ -330,31 +393,20 @@ class Trainer:
 
     # ------------------------------------------------------------------- eval
 
-    def _run_eval(self, arrays, eval_step):
+    def _run_eval(self, arrays, eval_runner):
         images, labels, weights = arrays
-        bs = self.hparams.batch_size
-        nb = len(weights) // bs
-        totals = {"loss_sum": 0.0, "top1_count": 0.0, "top5_count": 0.0, "count": 0.0}
-        device_totals = []
-        for b in range(nb):
-            sl = slice(b * bs, (b + 1) * bs)
-            device_totals.append(
-                eval_step(self.state, images[sl], labels[sl], weights[sl])
-            )
-        for m in device_totals:  # fetch after all dispatches (pipelined)
-            for k in totals:
-                totals[k] += float(m[k])
-        out = {
+        device_totals = eval_runner(self.state, images, labels, weights)
+        totals = {k: float(v) for k, v in device_totals.items()}  # one fetch
+        return {
             "loss": totals["loss_sum"] / totals["count"],
             "top1": 100.0 * totals["top1_count"] / totals["count"],
             "top5": 100.0 * totals["top5_count"] / totals["count"],
         }
-        return out
 
     def validate(self, epoch: int) -> dict[str, float]:
         """Whole-val-set metrics (reference ``validate``,
         ``src/single/trainer.py:175-194``)."""
-        out = self._run_eval(self._val, self.eval_step)
+        out = self._run_eval(self._val, self.eval_runner)
         return {"val_loss": out["loss"], "val_acc": out["top1"]}
 
     def test(self, state=None) -> dict[str, float]:
@@ -381,15 +433,17 @@ class Trainer:
                 from jax.experimental import multihost_utils
 
                 synced = multihost_utils.broadcast_one_to_all(
-                    jax.device_get((self.state.params, self.state.batch_stats))
+                    fetch_to_host((self.state.params, self.state.batch_stats))
                 )
-                params, batch_stats = put_replicated(synced, self.mesh)
                 self.state = self.state.replace(
-                    params=params, batch_stats=batch_stats
+                    params=place_tree(synced[0], self.state_sharding.params),
+                    batch_stats=place_tree(
+                        synced[1], self.state_sharding.batch_stats
+                    ),
                 )
         else:
             self.state = state
-        out = self._run_eval(self._tst, self.test_eval_step)
+        out = self._run_eval(self._tst, self.test_eval_runner)
         self.logger.info(
             f"[{self.hparams.backend.upper()} Version {self.version}] "
             f"test loss: {out['loss']:.4f}, "

@@ -18,6 +18,7 @@ from distributed_training_comparison_tpu.data import (
     CIFAR100_STD,
     DeviceDataset,
     HostLoader,
+    PrefetchLoader,
     epoch_permutation,
     get_datasets,
     get_trn_val_loader,
@@ -151,6 +152,61 @@ def test_host_loader_epoch_reshuffle_and_drop_last():
     e1 = [lbl.copy() for _, lbl in loader]
     assert all(np.array_equal(a, b) for a, b in zip(e0, e0b))
     assert not all(np.array_equal(a, b) for a, b in zip(e0, e1))
+
+
+def test_prefetch_loader_preserves_order_and_determinism():
+    """PrefetchLoader must yield exactly the wrapped loader's sequence —
+    same batches, same order, every epoch (the background thread buys
+    overlap, never reordering)."""
+    x, y = synthetic_dataset(256, num_classes=10, seed=3)
+    ds = DeviceDataset(x, y, num_classes=10)
+    for epoch in (0, 1):
+        raw = HostLoader(ds, 32, shuffle=True, drop_last=True, seed=9)
+        pre = PrefetchLoader(
+            HostLoader(ds, 32, shuffle=True, drop_last=True, seed=9), depth=3
+        )
+        raw.set_epoch(epoch)
+        pre.set_epoch(epoch)
+        raw_batches = list(raw)
+        pre_batches = list(pre)
+        assert len(pre) == len(raw) == len(raw_batches) == len(pre_batches)
+        for (rx, ry), (px, py) in zip(raw_batches, pre_batches):
+            np.testing.assert_array_equal(rx, px)
+            np.testing.assert_array_equal(ry, py)
+
+
+def test_prefetch_loader_abandoned_iteration_stops_producer():
+    """Breaking out mid-epoch must not leave the producer thread blocked
+    (trainer breaks at steps_per_epoch; errors abandon the generator)."""
+    import threading
+    import time
+
+    x, y = synthetic_dataset(512, num_classes=10, seed=4)
+    ds = DeviceDataset(x, y, num_classes=10)
+    before = threading.active_count()
+    for _ in range(5):
+        pre = PrefetchLoader(HostLoader(ds, 32, shuffle=False, seed=1), depth=2)
+        it = iter(pre)
+        next(it)
+        it.close()  # GeneratorExit → finally: stop + drain + join
+    time.sleep(1.0)
+    assert threading.active_count() <= before + 1
+
+
+def test_prefetch_loader_propagates_producer_errors():
+    class Boom:
+        def set_epoch(self, e):
+            pass
+
+        def __iter__(self):
+            yield (np.zeros(1), np.zeros(1))
+            raise RuntimeError("producer failed")
+
+    pre = PrefetchLoader(Boom(), depth=2)
+    it = iter(pre)
+    next(it)
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(it)
 
 
 def test_sharded_train_loaders_disjoint_per_epoch():

@@ -117,6 +117,27 @@ def test_bf16_policy_fp32_logits(rng):
     assert logits.dtype == jnp.float32
 
 
+def test_bn_stats_fp32_by_default_under_bf16(rng):
+    """Under the bf16 policy, BN statistics reduce in fp32 by default
+    (norm_dtype=fp32); norm_dtype=None opts back into compute-dtype stats.
+    The two short-run forward passes must stay close (same math, different
+    reduction precision) but the fp32 path is the accuracy-safe default."""
+    x = jax.random.normal(jax.random.key(3), (16, 32, 32, 3))
+    outs = {}
+    for tag, norm_dtype in (("fp32", jnp.float32), ("compute", None)):
+        model = get_model("resnet18", dtype=jnp.bfloat16, norm_dtype=norm_dtype)
+        variables = model.init(rng, x, train=False)
+        logits, _ = model.apply(variables, x, train=True, mutable=["batch_stats"])
+        assert jnp.all(jnp.isfinite(logits))
+        outs[tag] = logits
+    # same init → bf16-stats trajectory tracks fp32-stats within bf16 noise
+    np.testing.assert_allclose(outs["fp32"], outs["compute"], atol=0.15, rtol=0.1)
+    assert not jnp.array_equal(outs["fp32"], outs["compute"]), (
+        "bf16 stat reduction should differ at bit level — if identical, the "
+        "norm_dtype knob is not reaching BatchNorm"
+    )
+
+
 def test_num_classes_override(rng):
     model = get_model("resnet18", num_classes=10)
     variables = model.init(rng, jnp.zeros((1, 32, 32, 3)), train=False)

@@ -1,0 +1,77 @@
+"""Multi-host (2-process) integration test on CPU.
+
+Launches two real ``jax.distributed`` processes (coordinator on localhost,
+4 virtual CPU devices each → an 8-device global mesh) running
+``tests/mh_worker.py``.  This executes every ``process_count() > 1`` branch
+— rendezvous, global array assembly, cross-process gradient all-reduce,
+process-0 broadcast — none of which single-process CI can reach.  The
+reference's multi-node path shipped with zero tests (SURVEY.md §4).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+WORKER = Path(__file__).parent / "mh_worker.py"
+REPO = Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict[str, str]:
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=4"]
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the TPU plugin out of the workers
+    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_distributed_train_step():
+    port = _free_port()
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(rank), str(port)],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
+        kv = dict(item.split("=") for item in line.split()[1:])
+        results[int(kv["rank"])] = kv
+
+    assert set(results) == {0, 1}
+    for kv in results.values():
+        assert kv["procs"] == "2"
+        assert kv["step"] == "1"
+    # the all-reduced loss must be bit-identical across processes — the
+    # proof the two 'hosts' ran one synchronized SPMD program
+    assert results[0]["loss"] == results[1]["loss"]
+    assert results[0]["l2"] == results[1]["l2"]

@@ -1,0 +1,148 @@
+"""Tensor parallelism: params are genuinely partitioned over the "model"
+axis and the training math is unchanged by the layout.
+
+The reference has no tensor parallelism (SURVEY.md §2.2); these tests guard
+the beyond-parity capability: a (data, model) mesh where stage-3/4 convs and
+the classifier head are channel-sharded (parallel/tp.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu import models, parallel
+from distributed_training_comparison_tpu.parallel.tp import (
+    batch_stats_partition_specs,
+    param_partition_specs,
+    state_shardings,
+)
+from distributed_training_comparison_tpu.train import (
+    configure_optimizers,
+    create_train_state,
+    make_train_step,
+)
+
+
+class HP:
+    lr = 0.1
+    weight_decay = 1e-4
+    lr_decay_step_size = 25
+    lr_decay_gamma = 0.1
+
+
+def _make_state(model_name="resnet18"):
+    model = models.get_model(model_name)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=10)
+    return create_train_state(model, jax.random.key(0), tx)
+
+
+def _placed(mesh, state):
+    sh = state_shardings(mesh, state)
+    return parallel.place_tree(state, sh), sh
+
+
+def test_param_specs_shard_tp_stages_only():
+    state = _make_state()
+    specs = param_partition_specs(state.params)
+    # stage1/2 and stem fully replicated
+    flat = jax.tree_util.tree_leaves(
+        {k: v for k, v in specs.items() if not k.startswith(("stage3", "stage4", "head"))}
+    )
+    assert all(s == jax.sharding.PartitionSpec() for s in flat)
+    # BasicBlock: Conv_0 column-parallel, Conv_1 row-parallel
+    b0 = specs["stage3_block0"]
+    assert b0["Conv_0"]["kernel"] == jax.sharding.PartitionSpec(None, None, None, "model")
+    assert b0["Conv_1"]["kernel"] == jax.sharding.PartitionSpec(None, None, "model", None)
+    assert b0["BatchNorm_0"]["scale"] == jax.sharding.PartitionSpec("model")
+    assert b0["BatchNorm_1"]["scale"] == jax.sharding.PartitionSpec()
+    # shortcut replicated
+    assert b0["Conv_2"]["kernel"] == jax.sharding.PartitionSpec()
+    # head column-parallel over classes
+    assert specs["head"]["kernel"] == jax.sharding.PartitionSpec(None, "model")
+
+
+def test_bottleneck_specs():
+    state = _make_state("resnet50")
+    specs = param_partition_specs(state.params)
+    b0 = specs["stage3_block0"]
+    # Bottleneck: Conv_1 (3x3) column-parallel, Conv_2 (1x1 expand) row-parallel
+    assert b0["Conv_0"]["kernel"] == jax.sharding.PartitionSpec()
+    assert b0["Conv_1"]["kernel"] == jax.sharding.PartitionSpec(None, None, None, "model")
+    assert b0["Conv_2"]["kernel"] == jax.sharding.PartitionSpec(None, None, "model", None)
+    assert b0["BatchNorm_1"]["scale"] == jax.sharding.PartitionSpec("model")
+    # shortcut (Conv_3) replicated
+    assert b0["Conv_3"]["kernel"] == jax.sharding.PartitionSpec()
+
+
+def test_batch_stats_specs_follow_bn_params():
+    state = _make_state()
+    specs = batch_stats_partition_specs(state.params, state.batch_stats)
+    assert specs["stage3_block0"]["BatchNorm_0"]["mean"] == jax.sharding.PartitionSpec(
+        "model"
+    )
+    assert specs["stage3_block0"]["BatchNorm_1"]["var"] == jax.sharding.PartitionSpec()
+    # top-level stem BN has bare mean/var leaves — replicated, no crash
+    assert specs["stem_bn"]["mean"] == jax.sharding.PartitionSpec()
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+def test_params_actually_partitioned(mesh_shape):
+    mesh = parallel.make_mesh(8, mesh_shape[1], backend="tpu")
+    assert dict(mesh.shape) == {"data": mesh_shape[0], "model": mesh_shape[1]}
+    state = _make_state()
+    placed, _ = _placed(mesh, state)
+
+    k = placed.params["stage3_block0"]["Conv_0"]["kernel"]
+    assert not k.sharding.is_fully_replicated
+    shard_shapes = {s.data.shape for s in k.addressable_shards}
+    assert shard_shapes == {(3, 3, 128, 256 // mesh_shape[1])}
+    # distinct shards hold distinct data (it is a real partition, not copies)
+    uniq = {np.asarray(s.data).tobytes() for s in k.addressable_shards}
+    assert len(uniq) == mesh_shape[1]
+
+    # momentum trace inherits the layout (suffix matching through opt_state):
+    # the trace leaf for this conv kernel has its unique shape — assert it
+    # carries the same partitioned sharding, not a replicated fallback
+    trace_leaves = [
+        x
+        for x in jax.tree_util.tree_leaves(placed.opt_state)
+        if getattr(x, "shape", None) == k.shape
+    ]
+    assert trace_leaves, "momentum trace leaf for stage3 conv not found"
+    for t in trace_leaves:
+        assert t.sharding == k.sharding
+
+    head_kernel = placed.params["head"]["kernel"]
+    assert not head_kernel.sharding.is_fully_replicated
+
+    # replicated leaves stay replicated
+    stem = placed.params["stem_conv"]["kernel"]
+    assert stem.sharding.is_fully_replicated
+
+
+def test_tp_training_matches_dp_trajectory():
+    """Same data, same init: a (4,2) TP run must track the (8,1) DP run."""
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 255, size=(64, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 100, size=(64,), dtype=np.int32)
+
+    losses = {}
+    for mp in (1, 2):
+        mesh = parallel.make_mesh(8, mp, backend="tpu")
+        state = _make_state()
+        placed, sh = _placed(mesh, state)
+        step = make_train_step(
+            mesh, precision="fp32", augment=False, state_sharding=sh
+        )
+        bx, by = parallel.shard_batch((images, labels), mesh)
+        traj = []
+        for i in range(3):
+            placed, metrics = step(placed, bx, by, jax.random.key(7))
+            traj.append(float(metrics["loss"]))
+        losses[mp] = traj
+
+    # step 0 matches to fp32 ulp; later steps drift as lr=0.1 SGD amplifies
+    # partitioned-reduction ordering differences (observed ≤0.4% at step 3)
+    np.testing.assert_allclose(losses[1][:1], losses[2][:1], rtol=1e-5)
+    np.testing.assert_allclose(losses[1], losses[2], rtol=2e-2)

@@ -24,6 +24,7 @@ from distributed_training_comparison_tpu.train import (
     load_checkpoint,
     load_resume_state,
     make_epoch_runner,
+    make_eval_runner,
     make_eval_step,
     make_train_step,
     save_checkpoint,
@@ -153,6 +154,35 @@ def test_eval_step_weight_mask(mesh, tiny_data):
     np.testing.assert_allclose(
         float(m_half["loss_sum"]), float(m_sub["loss_sum"]), rtol=1e-5
     )
+
+
+def test_eval_runner_matches_per_batch_eval(mesh, tiny_data):
+    """The scanned whole-split eval must produce exactly the per-batch
+    step's totals (same core, one dispatch instead of nb)."""
+    x, y = tiny_data
+    state = _fresh_state(mesh)
+    bs = 64
+    ev = make_eval_step(mesh)
+    runner = make_eval_runner(mesh, bs)
+    shard = batch_sharding(mesh)
+    w = np.ones(len(x), np.float32)
+    w[-16:] = 0.0  # padding mask in the last batch
+
+    totals = {"loss_sum": 0.0, "top1_count": 0.0, "top5_count": 0.0, "count": 0.0}
+    for b in range(len(x) // bs):
+        sl = slice(b * bs, (b + 1) * bs)
+        m = ev(
+            state,
+            jax.device_put(x[sl], shard),
+            jax.device_put(y[sl], shard),
+            jax.device_put(jnp.asarray(w[sl]), shard),
+        )
+        for k in totals:
+            totals[k] += float(m[k])
+
+    scanned = runner(state, x, y, jnp.asarray(w))
+    for k in totals:
+        np.testing.assert_allclose(float(scanned[k]), totals[k], rtol=1e-5)
 
 
 def test_bf16_policy_keeps_fp32_state(mesh, tiny_data):
