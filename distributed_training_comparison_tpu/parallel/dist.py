@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import jax
 
+_initialized = False
+
 
 def init_distributed(hparams) -> None:
     """Initialize multi-host JAX if the config asks for it.
@@ -28,11 +30,17 @@ def init_distributed(hparams) -> None:
     world = getattr(hparams, "world_size", 1)
     if world <= 1:
         return
+    global _initialized
+    if _initialized:
+        # jax.distributed.initialize may only run once per process; repeat
+        # calls (e.g. results.py looping entry.run over seeds) are no-ops
+        return
     jax.distributed.initialize(
         coordinator_address=hparams.dist_url,
         num_processes=world,
         process_id=hparams.rank,
     )
+    _initialized = True
 
 
 def process_index() -> int:

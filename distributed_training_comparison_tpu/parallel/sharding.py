@@ -83,21 +83,44 @@ def fetch_to_host(tree):
     """Fetch a pytree of (possibly sharded, possibly multi-host) jax.Arrays
     to host numpy.
 
-    ``jax.device_get`` alone raises on arrays with non-addressable shards —
-    e.g. tensor-parallel params whose ``model`` axis spans hosts.  Such
-    leaves are all-gathered across processes first; fully-addressable leaves
-    (replicated or single-host) take the direct path.  Used by checkpointing
-    and the test-phase broadcast, which must see the *global* value.
+    Three paths per leaf:
+
+    - fully-addressable (single-host, any sharding): ``device_get``;
+    - multi-host but fully **replicated**: read this process's own shard —
+      it already holds the global value, so no collective is needed and the
+      call is safe from one process alone (e.g. the process-0-only
+      checkpoint writer under data parallelism);
+    - multi-host **partitioned** (e.g. tensor-parallel params whose
+      ``model`` axis spans hosts): a cross-process all-gather.  This is a
+      COLLECTIVE — every process must call ``fetch_to_host`` on the same
+      tree, from the main thread, or the job deadlocks.  Use
+      ``needs_collective_fetch`` to detect this case at call sites that
+      would otherwise run asymmetrically (process-0-only or in a worker
+      thread).
     """
 
     def fetch(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            if x.sharding.is_fully_replicated:
+                return np.asarray(x.addressable_shards[0].data)
             from jax.experimental import multihost_utils
 
             return np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return np.asarray(jax.device_get(x))
 
     return jax.tree_util.tree_map(fetch, tree)
+
+
+def needs_collective_fetch(tree) -> bool:
+    """True if ``fetch_to_host(tree)`` would involve a cross-process
+    collective (some leaf is multi-host *and* partitioned) — in which case
+    the fetch must be performed symmetrically on every process."""
+    return any(
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.sharding.is_fully_replicated
+        for x in jax.tree_util.tree_leaves(tree)
+    )
 
 
 def host_local_batch_slice(global_batch_size: int) -> int:

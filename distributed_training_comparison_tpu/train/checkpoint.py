@@ -51,10 +51,11 @@ def _state_dict(state: TrainState) -> dict[str, Any]:
     }
 
 
-def _to_host(tree):
-    # shard-safe: tensor-parallel leaves spanning hosts are all-gathered
-    # (plain device_get raises on non-addressable shards)
-    return fetch_to_host(tree)
+# Device→host reads below go through fetch_to_host: shard-safe for
+# replicated multi-host leaves (local read), but cross-host-partitioned
+# leaves require a symmetric collective — the Trainer pre-fetches those on
+# every process before handing the (then host-numpy) state to the writer
+# thread (see trainer.fit / parallel.needs_collective_fetch).
 
 
 def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_acc: float) -> Path:
@@ -67,8 +68,8 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
     for old in version_dir.glob(f"{BEST_PREFIX}*.ckpt"):
         old.unlink()
     payload = {
-        "params": serialization.to_state_dict(_to_host(state.params)),
-        "batch_stats": serialization.to_state_dict(_to_host(state.batch_stats)),
+        "params": serialization.to_state_dict(fetch_to_host(state.params)),
+        "batch_stats": serialization.to_state_dict(fetch_to_host(state.batch_stats)),
         "epoch": epoch,
         "val_acc": float(val_acc),
     }
@@ -97,7 +98,7 @@ def save_resume_state(
 ) -> Path:
     """Write the fully-resumable ``last.ckpt`` (capability the reference lacks)."""
     payload = {
-        "state": serialization.to_state_dict(_to_host(_state_dict(state))),
+        "state": serialization.to_state_dict(fetch_to_host(_state_dict(state))),
         "epoch": epoch,
         "best_acc": float(best_acc),
     }

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..config import WORKERS_DEFAULT
 from ..data import HostLoader, PrefetchLoader, get_datasets
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
 from ..models import get_model
@@ -42,6 +43,7 @@ from ..parallel import is_main_process, make_mesh, state_shardings
 from ..parallel.sharding import (
     fetch_to_host,
     host_local_batch_slice,
+    needs_collective_fetch,
     place_tree,
     put_replicated,
     shard_batch,
@@ -127,7 +129,7 @@ class Trainer:
             # --workers (reference DataLoader num_workers) sets the prefetch
             # depth; 0 means synchronous batch assembly, like the
             # reference's num_workers=0
-            workers = getattr(hparams, "workers", 2)
+            workers = getattr(hparams, "workers", WORKERS_DEFAULT)
             self.train_loader = (
                 PrefetchLoader(base_loader, depth=workers)
                 if workers > 0
@@ -318,30 +320,57 @@ class Trainer:
             self._log_tb("acc/epoch/val", val["val_acc"], epoch)
             self._log_tb("throughput/images_per_sec", imgs / epoch_time, epoch)
 
+            # Checkpoint decisions are computed on EVERY process from
+            # replicated values (val metrics are identical across hosts) so
+            # that the collective-fetch path below runs symmetrically.
+            state_ref, vdir = self.state, self.version_dir
+            want_best = val["val_acc"] > self.best_acc
+            if want_best:
+                self.best_acc = val["val_acc"]
+            is_last_epoch = epoch == hp.epoch - 1
+            due = (epoch + 1) % getattr(hp, "save_last_every", 1) == 0
+            # throttle: the full-state device→host fetch can exceed a
+            # fast epoch's compute time; cap the save rate (final epoch
+            # always saves so resume never loses the finished state).
+            # Wall-clock throttling can diverge across hosts, so it is
+            # only applied when the fetch involves no collective.
+            sync_fetch = jax.process_count() > 1 and needs_collective_fetch(
+                state_ref
+            )
+            min_secs = getattr(hp, "save_last_min_secs", 0.0) or 0.0
+            throttled = not sync_fetch and (
+                time.monotonic() - self._last_resume_save < min_secs
+            )
+            want_last = getattr(hp, "save_last", True) and (
+                is_last_epoch or (due and not throttled)
+            )
+            if (want_best or want_last) and sync_fetch:
+                # Cross-host-partitioned (tensor-parallel) leaves: the
+                # device→host fetch is an all-gather COLLECTIVE — run it
+                # here, on every process and on the main thread.  The
+                # process-0 writer thread then only serializes host numpy.
+                # Best-only saves need just params+batch_stats; the full
+                # state (opt_state included) is gathered only when the
+                # resumable last.ckpt is due — halves the DCN volume on
+                # best-improvement epochs.
+                if want_last:
+                    state_ref = fetch_to_host(state_ref)
+                else:
+                    state_ref = state_ref.replace(
+                        params=fetch_to_host(state_ref.params),
+                        batch_stats=fetch_to_host(state_ref.batch_stats),
+                    )
             if self.is_main:
                 # write-behind: the worker thread fetches + serializes while
                 # the next epoch computes (state buffers are not donated)
-                state_ref, vdir = self.state, self.version_dir
-                if val["val_acc"] > self.best_acc:
-                    self.best_acc = val["val_acc"]
+                if want_best:
                     self.ckpt_writer.submit(
                         lambda s=state_ref, e=epoch, b=self.best_acc: (
                             ckpt.save_checkpoint(vdir, s, e, b)
                         ),
                         key="best",
                     )
-                is_last_epoch = epoch == hp.epoch - 1
-                due = (epoch + 1) % getattr(hp, "save_last_every", 1) == 0
-                # throttle: the full-state device→host fetch can exceed a
-                # fast epoch's compute time; cap the save rate (final epoch
-                # always saves so resume never loses the finished state)
-                min_secs = getattr(hp, "save_last_min_secs", 0.0) or 0.0
-                throttled = (
-                    time.monotonic() - self._last_resume_save < min_secs
-                )
-                if getattr(hp, "save_last", True) and (
-                    is_last_epoch or (due and not throttled)
-                ):
+                if want_last:
                     self._last_resume_save = time.monotonic()
                     self.ckpt_writer.submit(
                         lambda s=state_ref, e=epoch, b=self.best_acc: (
@@ -429,18 +458,34 @@ class Trainer:
                 # Only process 0 has the checkpoint on disk; broadcast its
                 # params/BN stats so every host evaluates the same model
                 # (the reference instead lets rank 0 test alone on 1/N of
-                # the data — SURVEY.md §5 quirk 1).
+                # the data — SURVEY.md §5 quirk 1).  Every collective here
+                # must be entered by every process: first agree on whether a
+                # checkpoint was found, then broadcast host values — process
+                # 0 holds loaded numpy, the others contribute zero-filled
+                # placeholders of the same (global) shape, so no process
+                # ever needs an asymmetric device→host collective fetch.
                 from jax.experimental import multihost_utils
 
-                synced = multihost_utils.broadcast_one_to_all(
-                    fetch_to_host((self.state.params, self.state.batch_stats))
+                found = bool(
+                    multihost_utils.broadcast_one_to_all(
+                        np.asarray(best is not None)
+                    )
                 )
-                self.state = self.state.replace(
-                    params=place_tree(synced[0], self.state_sharding.params),
-                    batch_stats=place_tree(
-                        synced[1], self.state_sharding.batch_stats
-                    ),
-                )
+                if found:
+                    tree = (self.state.params, self.state.batch_stats)
+                    if self.is_main:
+                        host = jax.tree_util.tree_map(np.asarray, tree)
+                    else:
+                        host = jax.tree_util.tree_map(
+                            lambda l: np.zeros(l.shape, l.dtype), tree
+                        )
+                    synced = multihost_utils.broadcast_one_to_all(host)
+                    self.state = self.state.replace(
+                        params=place_tree(synced[0], self.state_sharding.params),
+                        batch_stats=place_tree(
+                            synced[1], self.state_sharding.batch_stats
+                        ),
+                    )
         else:
             self.state = state
         out = self._run_eval(self._tst, self.test_eval_runner)

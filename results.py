@@ -1,0 +1,85 @@
+"""Results-table workflow: run a backend over several seeds and emit the
+markdown row + CSV.
+
+The reference's published table is produced by hand: three runs with seeds
+2/4/42, best-val checkpoint each, averaged (``/root/reference/README.md:45-54``,
+methodology note at ``:53``), with a ``result.csv`` scratch file ignored by
+git (``.gitignore:4``).  Here the workflow is one command:
+
+    python results.py --backend tpu --seeds 2 4 42 -- --synthetic-data
+
+Everything after ``--`` is passed through to the backend's CLI (any flag
+``config.py`` accepts).  Each seed trains with ``--contain-test``, the test
+metrics of the best-val checkpoint are collected, and the script prints the
+per-seed rows plus the mean row in the reference table's format, appending
+machine-readable rows to ``result.csv``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import statistics
+import sys
+from pathlib import Path
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument(
+        "--backend", default="tpu", choices=["single", "dp", "ddp", "tpu"]
+    )
+    parser.add_argument(
+        "--seeds", type=int, nargs="+", default=[2, 4, 42],
+        help="Reference methodology: seeds 2/4/42 (README.md:53)",
+    )
+    parser.add_argument("--csv", default="result.csv")
+    args, passthrough = parser.parse_known_args()
+    if passthrough and passthrough[0] == "--":
+        passthrough = passthrough[1:]
+
+    from distributed_training_comparison_tpu import entry
+
+    rows = []
+    for seed in args.seeds:
+        argv = [*passthrough, "--seed", str(seed), "--contain-test"]
+        print(f"=== {args.backend} seed {seed}: {' '.join(argv)}", flush=True)
+        res = entry.run(args.backend, argv)
+        rows.append(
+            {
+                "backend": args.backend,
+                "seed": seed,
+                "version": res.get("version"),
+                "test_loss": res["test_loss"],
+                "test_top1": res["test_top1"],
+                "test_top5": res["test_top5"],
+            }
+        )
+
+    csv_path = Path(args.csv)
+    new_file = not csv_path.exists()
+    with csv_path.open("a", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        if new_file:
+            w.writeheader()
+        w.writerows(rows)
+
+    def mean(k):
+        return statistics.fmean(r[k] for r in rows)
+
+    print("\n| Method | Seed | Test loss | Top-1 | Top-5 |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        print(
+            f"| {r['backend']} | {r['seed']} | {r['test_loss']:.4f} "
+            f"| {r['test_top1']:.2f}% | {r['test_top5']:.2f}% |"
+        )
+    print(
+        f"| **{args.backend} (mean of {len(rows)})** | {'/'.join(map(str, args.seeds))} "
+        f"| **{mean('test_loss'):.4f}** | **{mean('test_top1'):.2f}%** "
+        f"| **{mean('test_top5'):.2f}%** |"
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,25 @@ def main(rank: int, port: int) -> None:
     state, metrics = step(state, bx, by, jax.random.key(1))
     loss = float(metrics["loss"])  # replicated global scalar
 
+    # fetch_to_host: multi-host replicated leaves take the collective-free
+    # local-read path (safe from one process alone); partitioned leaves take
+    # the symmetric all-gather path — both must return the global value
+    from distributed_training_comparison_tpu.parallel.sharding import (
+        fetch_to_host,
+        needs_collective_fetch,
+    )
+
+    host_params = fetch_to_host(state.params)  # replicated → local read
+    for leaf in jax.tree_util.tree_leaves(host_params):
+        assert isinstance(leaf, np.ndarray)
+    gvals = np.arange(32, dtype=np.float32)
+    sharded = parallel.shard_batch(gvals.reshape(2, 16)[rank], mesh)
+    assert needs_collective_fetch(sharded) and not needs_collective_fetch(
+        host_params
+    )
+    gathered = fetch_to_host(sharded)  # partitioned → all-gather, symmetric
+    assert np.array_equal(gathered, gvals), gathered
+
     # the test() broadcast pattern (train/trainer.py): process-0's params win
     from jax.experimental import multihost_utils
 

@@ -109,6 +109,34 @@ def test_random_crop_zero_offset_is_identity():
         assert np.array_equal(out[i], x[i]) or np.array_equal(out[i], x[i, :, ::-1, :])
 
 
+def test_random_crop_flip_matches_slice_reference():
+    # The one-hot-matmul formulation must be bit-identical to the obvious
+    # per-sample pad→dynamic_slice→flip formulation for the same key.
+    x = jnp.asarray(synthetic_dataset(32, seed=5)[0])
+    key = jax.random.key(9)
+    out = np.asarray(random_crop_flip(x, key))
+
+    padding = 4
+    crop_key, flip_key = jax.random.split(key)
+    offsets = np.asarray(jax.random.randint(crop_key, (32, 2), 0, 2 * padding + 1))
+    flips = np.asarray(jax.random.bernoulli(flip_key, 0.5, (32,)))
+    padded = np.pad(np.asarray(x), ((0, 0), (padding,) * 2, (padding,) * 2, (0, 0)))
+    for i in range(32):
+        dy, dx = offsets[i]
+        ref = padded[i, dy : dy + 32, dx : dx + 32, :]
+        if flips[i]:
+            ref = ref[:, ::-1, :]
+        assert np.array_equal(out[i], ref)
+
+
+def test_random_crop_flip_float_input_preserved():
+    x = jnp.asarray(synthetic_dataset(8, seed=1)[0]).astype(jnp.float32)
+    out = random_crop_flip(x, jax.random.key(3))
+    assert out.dtype == jnp.float32
+    # float selection is exact too: every output value exists in the padded input
+    assert set(np.unique(out)).issubset(set(np.unique(np.asarray(x))) | {0.0})
+
+
 def test_normalize_matches_torchvision_semantics():
     x = jnp.full((2, 4, 4, 3), 128, dtype=jnp.uint8)
     out = np.asarray(normalize_images(x))
