@@ -19,10 +19,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import DATA_AXIS
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-axis (batch) sharding over the data axis; feature axes and the
-    model axis stay unsharded for pure data parallelism."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+def batch_sharding(mesh: Mesh, axis: int = 0) -> NamedSharding:
+    """Sharding with dimension ``axis`` on the data axis (batch dim); feature
+    axes and the model axis stay unsharded for pure data parallelism.
+    ``axis=1`` is the chunked host-streaming layout ``(K, B, ...)``."""
+    return NamedSharding(mesh, P(*([None] * axis), DATA_AXIS))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -30,15 +31,19 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh):
+def shard_batch(batch, mesh: Mesh, batch_axis: int = 0):
     """Place a (possibly host-local) numpy batch as a global batch-sharded array.
 
     Single-host: a straight ``device_put`` with the batch sharding.
     Multi-host: each process contributes its local shard;
     ``make_array_from_process_local_data`` assembles the global array — the
     SPMD replacement for DistributedSampler feeding per-rank loaders.
+
+    ``batch_axis`` selects which axis rides the data axis (the chunked
+    host-streaming path stacks steps in front: ``(K, B, ...)`` →
+    ``batch_axis=1``).
     """
-    sharding = batch_sharding(mesh)
+    sharding = batch_sharding(mesh, axis=batch_axis)
     if jax.process_count() == 1:
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
     return jax.tree_util.tree_map(

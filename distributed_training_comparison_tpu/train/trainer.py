@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import WORKERS_DEFAULT
+from ..config import HOST_CHUNK_STEPS_DEFAULT, WORKERS_DEFAULT
 from ..data import HostLoader, PrefetchLoader, get_datasets
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
 from ..models import get_model
@@ -54,7 +54,7 @@ from . import checkpoint as ckpt
 from .async_ckpt import AsyncCheckpointer
 from .optim import configure_optimizers
 from .state import create_train_state
-from .step import make_epoch_runner, make_eval_runner, make_train_step
+from .step import make_chunk_runner, make_epoch_runner, make_eval_runner
 
 
 def _pad_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
@@ -166,10 +166,10 @@ class Trainer:
                 precision=self.precision,
                 state_sharding=self.state_sharding,
             )
-            self.train_step = None
+            self.chunk_runner = None
         else:
             self.epoch_runner = None
-            self.train_step = make_train_step(
+            self.chunk_runner = make_chunk_runner(
                 self.mesh,
                 precision=self.precision,
                 state_sharding=self.state_sharding,
@@ -400,24 +400,41 @@ class Trainer:
         return losses, top1
 
     def _train_epoch_host(self, epoch: int) -> tuple[np.ndarray, float]:
-        """Streaming epoch: per-step H2D of loader batches (the large-dataset
-        / multi-host path; reference analogue is the DataLoader loop with
-        DistributedSampler, ``src/ddp/trainer.py:143-174``)."""
+        """Streaming epoch: loader batches are stacked into chunks of
+        ``--host-chunk-steps`` and each chunk runs as ONE scanned dispatch
+        (the large-dataset / multi-host path; reference analogue is the
+        DataLoader loop, ``src/ddp/trainer.py:143-174``).
+
+        Per-step dispatch + H2D round-trips leave the chip idle between
+        tiny step programs; chunking amortizes that latency K× while the
+        prefetch thread assembles the next chunk.  Keys are folded from the
+        global step index inside the chunk, so the trajectory is identical
+        for any chunk size.
+        """
         self.train_loader.set_epoch(epoch)
         epoch_key = jax.random.fold_in(self.data_key, epoch)
-        step_metrics = []
-        loader = self.train_loader
-        bar = self._progress_bar(loader, desc=f"epoch {epoch}")
-        for i, (bx, by) in enumerate(bar if bar is not None else loader):
-            if i >= self.steps_per_epoch:
-                break
-            batch = shard_batch({"x": bx, "y": by}, self.mesh)
-            self.state, metrics = self.train_step(
-                self.state, batch["x"], batch["y"], jax.random.fold_in(epoch_key, i)
+        chunk = max(1, getattr(self.hparams, "host_chunk_steps", HOST_CHUNK_STEPS_DEFAULT))
+        chunk_metrics = []
+        it = iter(self.train_loader)
+        bar = self._progress_bar(range(self.steps_per_epoch), desc=f"epoch {epoch}")
+        done = 0
+        while done < self.steps_per_epoch:
+            take = min(chunk, self.steps_per_epoch - done)
+            xs, ys = zip(*(next(it) for _ in range(take)))
+            batch = shard_batch(
+                {"x": np.stack(xs), "y": np.stack(ys)}, self.mesh, batch_axis=1
             )
-            step_metrics.append(metrics)  # device scalars; no per-step sync
-        losses = np.asarray([float(m["loss"]) for m in step_metrics])
-        top1 = float(sum(float(m["top1_count"]) for m in step_metrics))
+            self.state, metrics = self.chunk_runner(
+                self.state, batch["x"], batch["y"], epoch_key, jnp.asarray(done)
+            )
+            chunk_metrics.append(metrics)  # (take,) device arrays; no sync
+            done += take
+            if bar is not None:
+                bar.update(take)
+        if bar is not None:
+            bar.close()
+        losses = np.concatenate([np.asarray(m["loss"]) for m in chunk_metrics])
+        top1 = float(sum(float(np.asarray(m["top1_count"]).sum()) for m in chunk_metrics))
         return losses, top1
 
     # ------------------------------------------------------------------- eval

@@ -75,3 +75,45 @@ def test_two_process_distributed_train_step():
     # proof the two 'hosts' ran one synchronized SPMD program
     assert results[0]["loss"] == results[1]["loss"]
     assert results[0]["l2"] == results[1]["l2"]
+
+
+def test_two_process_trainer_fit_ckpt_test(tmp_path):
+    """Full Trainer path over 2 processes with cross-process tensor
+    parallelism: fit (symmetric TP state fetch + process-0 checkpoint
+    writer) → test (found-flag broadcast).  Would deadlock if any
+    collective ran asymmetrically."""
+    port = _free_port()
+    env = _worker_env()
+    worker = Path(__file__).parent / "mh_trainer_worker.py"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(rank), str(port), str(tmp_path)],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
+        kv = dict(item.split("=") for item in line.split()[1:])
+        results[int(kv["rank"])] = kv
+
+    assert set(results) == {0, 1}
+    # global eval metrics are replicated: both 'hosts' must agree exactly
+    assert results[0]["loss"] == results[1]["loss"]
+    assert results[0]["top1"] == results[1]["top1"]
+    # artifacts written by process 0 only
+    vdir = tmp_path / f"version-{results[0]['version']}"
+    assert (vdir / "last.ckpt").exists()
+    assert list(vdir.glob("best_model_*.ckpt"))

@@ -67,6 +67,28 @@ def test_test_metrics_shape(run_dir):
     assert results["test_loss"] > 0
 
 
+def test_host_mode_chunk_invariance(tmp_path):
+    """The chunked host-streaming path must produce a bit-identical loss
+    trajectory for any --host-chunk-steps (keys fold from the global step
+    index inside the scan), and its state must advance like the device
+    path's."""
+    losses = {}
+    for chunk in (1, 4):
+        hp = _hparams(
+            tmp_path / f"c{chunk}",
+            extra=["--data-mode", "host", "--host-chunk-steps", str(chunk)],
+        )
+        t = Trainer(hp, model=TinyNet(num_classes=100))
+        ls, top1 = t._train_epoch_host(0)
+        losses[chunk] = (ls, top1, int(np.asarray(t.state.step)))
+        t.close()
+    l1, t1, s1 = losses[1]
+    l4, t4, s4 = losses[4]
+    assert s1 == s4 == len(l1) == len(l4)
+    assert t1 == t4
+    np.testing.assert_array_equal(l1, l4)
+
+
 def test_resume_continues(run_dir, tmp_path):
     src_tmp, version, _, trainer = run_dir
     last = src_tmp / f"version-{version}" / "last.ckpt"
