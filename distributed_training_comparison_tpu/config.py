@@ -160,6 +160,15 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "capability absent in the reference — see SURVEY.md §5)",
     )
     parser.add_argument(
+        "--auto-resume",
+        action="store_true",
+        default=False,
+        help="Continue the newest interrupted run under --ckpt-path (its "
+        "version dir + last.ckpt) if one exists; otherwise start fresh. "
+        "The crash-restart flag: relaunch the same command after a "
+        "failure and training picks up where it stopped",
+    )
+    parser.add_argument(
         "--save-last",
         action=argparse.BooleanOptionalAction,
         default=True,

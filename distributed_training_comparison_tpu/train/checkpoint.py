@@ -74,7 +74,9 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
         "val_acc": float(val_acc),
     }
     path = version_dir / f"{BEST_PREFIX}epoch_{epoch}_acc_{val_acc:.4f}.ckpt"
-    path.write_bytes(serialization.msgpack_serialize(payload))
+    tmp = path.with_suffix(".tmp")  # atomic-ish, like save_resume_state
+    tmp.write_bytes(serialization.msgpack_serialize(payload))
+    tmp.replace(path)
     return path
 
 
@@ -84,6 +86,26 @@ def load_checkpoint(path: str | Path, state: TrainState) -> TrainState:
     params = serialization.from_state_dict(state.params, raw["params"])
     batch_stats = serialization.from_state_dict(state.batch_stats, raw["batch_stats"])
     return state.replace(params=params, batch_stats=batch_stats)
+
+
+def find_latest_resume(ckpt_root: str | Path) -> Path | None:
+    """The NEWEST version dir's ``last.ckpt``, or None.
+
+    The --auto-resume discovery step: a relaunched job picks up exactly
+    where the newest run stopped (every process scans the same shared
+    checkpoint path, so multi-host relaunches agree).  Only the newest
+    version is considered — if it crashed before its first save (or ran
+    with --no-save-last), auto-resume starts fresh rather than silently
+    resuming into an older, possibly completed run's directory."""
+    root = Path(ckpt_root)
+    dirs = [
+        d for d in root.glob("version-*") if d.name.split("-")[-1].isdigit()
+    ]
+    if not dirs:
+        return None
+    newest = max(dirs, key=lambda d: int(d.name.split("-")[-1]))
+    path = newest / LAST_NAME
+    return path if path.exists() else None
 
 
 def find_best_checkpoint(version_dir: str | Path) -> Path | None:

@@ -202,8 +202,28 @@ class Trainer:
         self.start_epoch = 0
         self.version_dir: Path | None = None
         self.writer = None
+        # --auto-resume: continue the newest interrupted run in place (its
+        # version dir, its last.ckpt) — the crash-restart story the
+        # reference lacks entirely (torchelastic is quoted in its README but
+        # never implemented, SURVEY.md §5).  Explicit --resume wins.
+        auto_resumed = False
+        if getattr(hparams, "auto_resume", False) and not getattr(
+            hparams, "resume", None
+        ):
+            latest = ckpt.find_latest_resume(hparams.ckpt_path)
+            if latest is not None:
+                hparams.resume = str(latest)
+                auto_resumed = True
         if self.is_main:
-            self.version_dir = ckpt.find_version_dir(hparams.ckpt_path)
+            # Only an auto-DISCOVERED checkpoint continues in its own
+            # version dir; an explicit --resume (even with --auto-resume
+            # set) starts a fresh version under --ckpt-path so it can never
+            # clobber the source run's artifacts.
+            self.version_dir = (
+                Path(hparams.resume).parent
+                if auto_resumed
+                else ckpt.find_version_dir(hparams.ckpt_path)
+            )
             self.writer = SummaryWriter(self.version_dir / "tb")
             self._dump_hparams()
         self.logger = setup_logger(
@@ -290,6 +310,31 @@ class Trainer:
                 jax.profiler.stop_trace()
                 self.logger.info(f"profiler trace written to {hp.profile_dir}")
             imgs = self.steps_per_epoch * hp.batch_size
+
+            # failure detection (absent in the reference, SURVEY.md §5): a
+            # diverged run would otherwise burn the remaining epochs and
+            # poison every later checkpoint — stop at the first non-finite
+            # loss and point at the last good state
+            if not np.isfinite(losses).all():
+                bad = int(np.argmin(np.isfinite(losses)))
+                if self.ckpt_writer is not None:
+                    # drain in-flight best/last writes: the daemon writer
+                    # must not die mid-save when the exception exits
+                    self.ckpt_writer.wait()
+                last_good = (
+                    self.version_dir / ckpt.LAST_NAME
+                    if self.version_dir is not None
+                    else None
+                )
+                if last_good is not None and not last_good.exists():
+                    last_good = None
+                msg = (
+                    f"non-finite train loss at epoch {epoch}, step {bad} "
+                    f"(global step {epoch * self.steps_per_epoch + bad}) — "
+                    f"aborting; last saved state: {last_good or 'none'}"
+                )
+                self.logger.error(msg)
+                raise FloatingPointError(msg)
 
             meter = AverageMeter()
             for i, loss in enumerate(losses):

@@ -67,6 +67,62 @@ def test_test_metrics_shape(run_dir):
     assert results["test_loss"] > 0
 
 
+def test_auto_resume_continues_latest_run(run_dir):
+    """--auto-resume must reuse the newest version dir + last.ckpt instead
+    of starting a fresh version."""
+    src_tmp, version, _, trainer = run_dir
+    hp = _hparams(src_tmp, extra=["--auto-resume", "--epoch", "3"])
+    t2 = Trainer(hp, model=TinyNet(num_classes=100))
+    assert t2.start_epoch == 2
+    assert t2.version == version  # same run continued, not a new version dir
+    assert int(np.asarray(t2.state.step)) == int(np.asarray(trainer.state.step))
+    t2.close()
+
+
+def test_auto_resume_skips_newest_run_without_last_ckpt(run_dir, tmp_path):
+    """If the newest version crashed before its first save, auto-resume must
+    start fresh — not silently resume an older (completed) run in place."""
+    import shutil
+
+    src_tmp, version, _, _ = run_dir
+    shutil.copytree(src_tmp / f"version-{version}", tmp_path / f"version-{version}")
+    (tmp_path / f"version-{version + 1}").mkdir()  # crashed, no last.ckpt
+    hp = _hparams(tmp_path, extra=["--auto-resume", "--epoch", "1"])
+    t = Trainer(hp, model=TinyNet(num_classes=100))
+    assert t.start_epoch == 0
+    assert t.version == version + 2  # a fresh version dir
+    t.close()
+
+
+def test_explicit_resume_with_auto_flag_uses_fresh_version_dir(run_dir, tmp_path):
+    """--resume PATH (even alongside --auto-resume) must write into a new
+    version under --ckpt-path, never into the source run's directory."""
+    src_tmp, version, _, _ = run_dir
+    last = src_tmp / f"version-{version}" / "last.ckpt"
+    hp = _hparams(tmp_path, extra=["--auto-resume", "--resume", str(last), "--epoch", "3"])
+    t = Trainer(hp, model=TinyNet(num_classes=100))
+    assert t.start_epoch == 2  # state restored from the source checkpoint
+    assert t.version_dir.parent == tmp_path  # but artifacts go to a new dir
+    t.close()
+
+
+def test_auto_resume_without_checkpoint_starts_fresh(tmp_path):
+    hp = _hparams(tmp_path, extra=["--auto-resume", "--epoch", "1"])
+    t = Trainer(hp, model=TinyNet(num_classes=100))
+    assert t.start_epoch == 0 and t.version == 0
+    t.close()
+
+
+def test_nan_loss_aborts_run(tmp_path):
+    """Failure detection: a diverged epoch must abort with a pointer to the
+    last saved state instead of training on."""
+    hp = _hparams(tmp_path, extra=["--lr", "1e8"])  # guaranteed divergence
+    t = Trainer(hp, model=TinyNet(num_classes=100))
+    with pytest.raises(FloatingPointError, match="non-finite train loss"):
+        t.fit()
+    t.close()
+
+
 def test_host_mode_chunk_invariance(tmp_path):
     """The chunked host-streaming path must produce a bit-identical loss
     trajectory for any --host-chunk-steps (keys fold from the global step
