@@ -10,7 +10,9 @@ native legs are this framework's TPU path: device-resident data, in-jit
 augmentation, one ``lax.scan`` dispatch per epoch.
 
 Configs (BASELINE.json "configs"): rn18/bs256 bf16 (headline), rn18/bs256
-fp32, rn50/bs512 bf16.  Each native leg reports MFU = achieved training
+fp32, rn50/bs512 bf16, and the ImageNet-scale leg rn50@224px bf16 through
+the 7×7/2 + maxpool stem (synthetic data — the dataset itself is
+unobtainable offline).  Each native leg reports MFU = achieved training
 FLOP/s ÷ chip peak, with model FLOPs counted analytically from the
 architecture (conv MACs × 2, backward ≈ 2× forward).
 
@@ -48,12 +50,17 @@ class HP:
 # ----------------------------------------------------------- analytic FLOPs
 
 
-def forward_flops_per_image(name: str, num_classes: int = 100) -> float:
-    """Analytic forward FLOPs/image for the CIFAR ResNet family: conv MACs
-    × 2 on the actual feature-map sizes (32×32 stem, no maxpool), + the
-    linear head.  BN/ReLU/pool omitted (<1% of conv FLOPs).  Architecture
-    (block kind, depths, widths, strides) is read from the zoo model itself
-    so this can never silently diverge from models/resnet.py."""
+def forward_flops_per_image(
+    name: str,
+    num_classes: int = 100,
+    image_size: int = 32,
+    stem: str = "cifar",
+) -> float:
+    """Analytic forward FLOPs/image for the ResNet zoo: conv MACs × 2 on the
+    actual feature-map sizes, + the linear head.  BN/ReLU/pool omitted
+    (<1% of conv FLOPs).  Architecture (block kind, depths, widths,
+    strides) is read from the zoo model itself so this can never silently
+    diverge from models/resnet.py."""
     from distributed_training_comparison_tpu.models.resnet import BasicBlock, ResNet
 
     m = models.get_model(name, num_classes=num_classes)
@@ -61,8 +68,13 @@ def forward_flops_per_image(name: str, num_classes: int = 100) -> float:
     depths = m.num_blocks
     widths, strides = ResNet.STAGE_WIDTHS, ResNet.STAGE_STRIDES
     exp = 1 if kind == "basic" else 4
-    hw = 32
-    macs = 3 * 3 * 3 * 64 * hw * hw  # stem
+    if stem == "imagenet":
+        hw = image_size // 2  # 7×7 stride-2 conv
+        macs = 7 * 7 * 3 * 64 * hw * hw
+        hw //= 2  # 3×3 stride-2 maxpool
+    else:
+        hw = image_size
+        macs = 3 * 3 * 3 * 64 * hw * hw  # 3×3 stride-1 CIFAR stem
     cin = 64
     for planes, stride, blocks in zip(widths, strides, depths):
         for i in range(blocks):
@@ -83,10 +95,12 @@ def forward_flops_per_image(name: str, num_classes: int = 100) -> float:
     return 2.0 * macs
 
 
-def train_flops_per_image(name: str) -> float:
+def train_flops_per_image(
+    name: str, image_size: int = 32, stem: str = "cifar"
+) -> float:
     """fwd + bwd ≈ 3× fwd (standard estimate: grad-wrt-input + grad-wrt-
     weights each cost ≈ one forward)."""
-    return 3.0 * forward_flops_per_image(name)
+    return 3.0 * forward_flops_per_image(name, image_size=image_size, stem=stem)
 
 
 # per-chip peak dense-matmul FLOP/s (bf16), by jax device_kind
@@ -112,9 +126,11 @@ def chip_peak_flops() -> float | None:
 # ----------------------------------------------------------------- harness
 
 
-def _setup(mesh, model_name: str, precision: str):
+def _setup(mesh, model_name: str, precision: str, stem: str = "cifar"):
     model = models.get_model(
-        model_name, dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32
+        model_name,
+        dtype=jnp.bfloat16 if precision == "bf16" else jnp.float32,
+        stem=stem,
     )
     tx, _ = configure_optimizers(HP, steps_per_epoch=100)
     state = create_train_state(model, jax.random.key(0), tx)
@@ -122,10 +138,11 @@ def _setup(mesh, model_name: str, precision: str):
 
 
 def bench_native(
-    mesh, images, labels, model_name: str, precision: str, batch_size: int, epochs: int
+    mesh, images, labels, model_name: str, precision: str, batch_size: int,
+    epochs: int, stem: str = "cifar"
 ) -> float:
     """Native leg: scanned epoch over the HBM-resident split."""
-    state = _setup(mesh, model_name, precision)
+    state = _setup(mesh, model_name, precision, stem)
     repl = parallel.replicated_sharding(mesh)
     d_images = jax.device_put(images, repl)
     d_labels = jax.device_put(labels, repl)
@@ -172,29 +189,46 @@ def bench_reference_style(mesh, images, labels, batch_size: int, steps: int) -> 
 
 
 def main() -> None:
+    from distributed_training_comparison_tpu.utils import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
     platform = jax.devices()[0].platform
     mesh = parallel.make_mesh(backend="tpu")
     n_chips = mesh.shape["data"] * mesh.shape["model"]
     peak = chip_peak_flops()
 
+    # (model, precision, batch, image_size, stem, n_examples, epochs)
     if platform == "cpu":  # CI smoke sizing
-        n, epochs, ref_steps = 2_048, 1, 4
-        configs = [("resnet18", "bf16", 128)]
+        ref_steps = 4
+        configs = [("resnet18", "bf16", 128, 32, "cifar", 2_048, 1)]
     else:
-        n, epochs, ref_steps = 45_056, 3, 60
+        ref_steps = 60
         configs = [
-            ("resnet18", "bf16", 256),  # headline (north-star config)
-            ("resnet18", "fp32", 256),
-            ("resnet50", "bf16", 512),
+            ("resnet18", "bf16", 256, 32, "cifar", 45_056, 3),  # headline
+            ("resnet18", "fp32", 256, 32, "cifar", 45_056, 3),
+            ("resnet50", "bf16", 512, 32, "cifar", 45_056, 3),
+            # ImageNet-scale PROXY for BASELINE.json config 5 (which
+            # specifies ImageNet-1k bs=1024 on v3-32): synthetic 224×224
+            # inputs through the 7×7/2 + maxpool stem, 100-class head,
+            # batch sized for one chip
+            ("resnet50", "bf16", 128, 224, "imagenet", 4_096, 2),
         ]
 
-    images, labels = synthetic_dataset(n, num_classes=100, seed=0)
-
     per_config = {}
-    for model_name, precision, batch in configs:
-        ips = bench_native(mesh, images, labels, model_name, precision, batch, epochs)
+    ref_data = None  # config-0 arrays, reused by the baseline leg below
+    for model_name, precision, batch, image_size, stem, n, epochs in configs:
+        images, labels = synthetic_dataset(
+            n, num_classes=100, image_shape=(image_size, image_size, 3), seed=0
+        )
+        if ref_data is None:
+            ref_data = (images, labels)
+        ips = bench_native(
+            mesh, images, labels, model_name, precision, batch, epochs, stem
+        )
         ips_chip = ips / n_chips
-        flops = train_flops_per_image(model_name)
+        flops = train_flops_per_image(model_name, image_size, stem)
         # MFU only for bf16 legs: _PEAK_FLOPS is the bf16 dense-matmul peak;
         # fp32 peak differs per TPU generation, so a bf16-peak ratio would
         # not be a real utilization figure for the fp32 config
@@ -203,7 +237,10 @@ def main() -> None:
             if peak and precision == "bf16"
             else None
         )
-        per_config[f"{model_name}_{precision}_bs{batch}"] = {
+        cfg_key = f"{model_name}_{precision}_bs{batch}" + (
+            f"_{image_size}px" if stem == "imagenet" else ""
+        )
+        per_config[cfg_key] = {
             "images_per_sec_per_chip": round(ips_chip, 1),
             "train_flops_per_image": round(flops / 1e9, 3),  # GFLOPs
             "achieved_tflops": round(ips_chip * flops / 1e12, 2),
@@ -212,8 +249,9 @@ def main() -> None:
 
     headline_key = next(iter(per_config))
     headline = per_config[headline_key]["images_per_sec_per_chip"]
+    # baseline leg runs exactly the headline config's workload/data
     ref_style = bench_reference_style(
-        mesh, images, labels, configs[0][2], ref_steps
+        mesh, ref_data[0], ref_data[1], configs[0][2], ref_steps
     )
 
     print(

@@ -146,6 +146,21 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Train on generated data (benchmark mode / no dataset on disk)",
     )
     parser.add_argument(
+        "--image-size",
+        type=int,
+        default=32,
+        help="Synthetic image edge length (e.g. 224 with --stem imagenet "
+        "for ImageNet-scale benchmarking)",
+    )
+    parser.add_argument(
+        "--stem",
+        type=str,
+        default="cifar",
+        choices=["cifar", "imagenet"],
+        help="Model stem: 'cifar' = 3x3/1 conv, no maxpool (reference "
+        "parity); 'imagenet' = 7x7/2 conv + 3x3/2 maxpool for large images",
+    )
+    parser.add_argument(
         "--limit-examples",
         type=int,
         default=0,

@@ -59,9 +59,11 @@ def _raw_split(hparams, split: str) -> tuple[np.ndarray, np.ndarray]:
         n = 50_000 if split == "train" else 10_000
         if limit:
             n = min(n, limit)
+        size = getattr(hparams, "image_size", 32) or 32
         return synthetic_dataset(
             n,
             num_classes=100,
+            image_shape=(size, size, 3),
             seed=hparams.seed + (split == "test"),
             anchor_seed=hparams.seed,
         )

@@ -20,11 +20,13 @@ from typing import Sequence
 from .config import load_config
 from .parallel import init_distributed, is_main_process
 from .train import Trainer
+from .utils import enable_persistent_compilation_cache
 
 
 def run(backend: str, argv: Sequence[str] | None = None) -> dict:
     """Train (and optionally test) one run of the given backend variant."""
     hparams = load_config(backend, argv)
+    enable_persistent_compilation_cache()
     init_distributed(hparams)
 
     trainer = Trainer(hparams)

@@ -132,13 +132,21 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
-    """CIFAR ResNet trunk: stem → 4 stages → pool → linear head."""
+    """ResNet trunk: stem → 4 stages → pool → linear head.
+
+    ``stem="cifar"`` (default) is the reference's 32×32 variant: 3×3 conv,
+    stride 1, no maxpool (``net.py:91-92``).  ``stem="imagenet"`` is the
+    standard large-image variant (7×7 stride-2 conv + 3×3 stride-2 maxpool)
+    — beyond-parity, for the ImageNet-scale configs in BASELINE.json; the
+    trunk, global average pool and head are shared.
+    """
 
     block: Callable[..., nn.Module]
     num_blocks: Sequence[int]
     num_classes: int = 100
     dtype: Any = jnp.float32
     norm_dtype: Any = jnp.float32
+    stem: str = "cifar"
 
     STAGE_WIDTHS = (64, 128, 256, 512)
     STAGE_STRIDES = (1, 2, 2, 2)
@@ -146,7 +154,19 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
         x = x.astype(self.dtype)
-        x = Conv3x3(64, strides=1, dtype=self.dtype, name="stem_conv")(x)
+        if self.stem == "imagenet":
+            x = nn.Conv(
+                64,
+                kernel_size=(7, 7),
+                strides=2,
+                padding=3,
+                use_bias=False,
+                kernel_init=nn.initializers.he_normal(),
+                dtype=self.dtype,
+                name="stem_conv",
+            )(x)
+        else:
+            x = Conv3x3(64, strides=1, dtype=self.dtype, name="stem_conv")(x)
         x = nn.BatchNorm(
             use_running_average=not train,
             momentum=BN_MOMENTUM,
@@ -155,6 +175,10 @@ class ResNet(nn.Module):
             name="stem_bn",
         )(x)
         x = nn.relu(x)
+        if self.stem == "imagenet":
+            x = nn.max_pool(
+                x, window_shape=(3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+            )
         for stage, (planes, stride, blocks) in enumerate(
             zip(self.STAGE_WIDTHS, self.STAGE_STRIDES, self.num_blocks)
         ):

@@ -95,7 +95,10 @@ class Trainer:
             else jnp.float32
         )
         self.model = model if model is not None else get_model(
-            hparams.model, dtype=compute_dtype, norm_dtype=norm_dtype
+            hparams.model,
+            dtype=compute_dtype,
+            norm_dtype=norm_dtype,
+            stem=getattr(hparams, "stem", "cifar"),
         )
 
         # --- data.  'device' mode: split is HBM-resident and replicated;

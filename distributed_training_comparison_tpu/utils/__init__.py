@@ -8,5 +8,13 @@ from .seed import fix_seed
 from .meters import AverageMeter
 from .metrics import accuracy, topk_correct
 from .logging import setup_logger
+from .compile_cache import enable_persistent_compilation_cache
 
-__all__ = ["fix_seed", "AverageMeter", "accuracy", "topk_correct", "setup_logger"]
+__all__ = [
+    "fix_seed",
+    "AverageMeter",
+    "accuracy",
+    "topk_correct",
+    "setup_logger",
+    "enable_persistent_compilation_cache",
+]

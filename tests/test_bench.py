@@ -1,0 +1,39 @@
+"""Bench-harness unit tests: the analytic FLOP model.
+
+The throughput/MFU numbers the driver records are only as honest as this
+formula; pin it against published reference points (torchvision MAC counts
+× 2) so architecture edits that break the accounting fail loudly.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from bench import forward_flops_per_image, train_flops_per_image  # noqa: E402
+
+
+def test_cifar_resnet18_flops():
+    # 0.557 GMACs for CIFAR ResNet-18 at 32×32 → 1.11 GFLOPs forward
+    assert forward_flops_per_image("resnet18") == pytest.approx(1.111e9, rel=0.01)
+
+
+def test_imagenet_resnet50_flops_match_published():
+    # torchvision resnet50 @224: 4.09 GMACs → 8.18 GFLOPs forward
+    f = forward_flops_per_image("resnet50", 1000, 224, "imagenet")
+    assert f == pytest.approx(8.18e9, rel=0.01)
+
+
+def test_imagenet_resnet18_flops_match_published():
+    # torchvision resnet18 @224: 1.81 GMACs → 3.63 GFLOPs forward
+    f = forward_flops_per_image("resnet18", 1000, 224, "imagenet")
+    assert f == pytest.approx(3.63e9, rel=0.01)
+
+
+def test_train_is_three_forwards():
+    assert train_flops_per_image("resnet50", 224, "imagenet") == pytest.approx(
+        3 * forward_flops_per_image("resnet50", image_size=224, stem="imagenet"),
+        rel=1e-9,
+    )

@@ -84,6 +84,37 @@ def test_resnet18_known_torch_count(rng):
     assert n_params(variables["params"]) == 11_220_132
 
 
+@pytest.mark.parametrize(
+    "name,torch_count",
+    [("resnet18", 11_689_512), ("resnet50", 25_557_032)],
+)
+def test_imagenet_stem_matches_torchvision_param_count(name, torch_count, rng):
+    """stem='imagenet' (7×7/2 conv + maxpool) must reproduce the canonical
+    torchvision ImageNet ResNet parameter totals exactly at
+    num_classes=1000 — the strongest architecture-parity check available
+    offline (the totals are torchvision's published counts)."""
+    model = get_model(name, num_classes=1000, stem="imagenet")
+    # small spatial input keeps CPU init cheap; param count is size-free
+    variables = model.init(rng, jnp.zeros((1, 64, 64, 3)), train=False)
+    assert n_params(variables["params"]) == torch_count
+
+
+def test_imagenet_stem_downsamples_4x(rng):
+    """7×7/2 conv + 3×3/2 maxpool: a 224 input must enter stage 1 at 56
+    and leave stage 4 at 7.  The head's global mean pool erases spatial
+    size, so probe real intermediates, not the logits shape."""
+    model = get_model("resnet18", stem="imagenet")
+    x = jnp.zeros((1, 224, 224, 3))
+    variables = model.init(rng, x, train=False)
+    logits, mods = model.apply(
+        variables, x, train=False, capture_intermediates=True
+    )
+    inter = mods["intermediates"]
+    assert logits.shape == (1, 100)
+    assert inter["stage1_block0"]["__call__"][0].shape == (1, 56, 56, 64)
+    assert inter["stage4_block1"]["__call__"][0].shape == (1, 7, 7, 512)
+
+
 def test_train_mode_updates_batch_stats(rng):
     model = get_model("resnet18")
     x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
