@@ -218,10 +218,13 @@ def main() -> None:
 
     per_config = {}
     ref_data = None  # config-0 arrays, reused by the baseline leg below
+    data_cache = {}  # identical (n, image_size) datasets generated once
     for model_name, precision, batch, image_size, stem, n, epochs in configs:
-        images, labels = synthetic_dataset(
-            n, num_classes=100, image_shape=(image_size, image_size, 3), seed=0
-        )
+        if (n, image_size) not in data_cache:
+            data_cache[n, image_size] = synthetic_dataset(
+                n, num_classes=100, image_shape=(image_size, image_size, 3), seed=0
+            )
+        images, labels = data_cache[n, image_size]
         if ref_data is None:
             ref_data = (images, labels)
         ips = bench_native(

@@ -146,6 +146,23 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Train on generated data (benchmark mode / no dataset on disk)",
     )
     parser.add_argument(
+        "--remat",
+        action="store_true",
+        default=False,
+        help="Rematerialize residual blocks on backward (jax.checkpoint): "
+        "~1/3 extra FLOPs for a large cut in peak activation memory — "
+        "enables batches/models that otherwise OOM",
+    )
+    parser.add_argument(
+        "--grad-accum",
+        type=int,
+        default=1,
+        help="Gradient accumulation: split each global batch into N "
+        "sequential micro-batches, average their grads, apply ONE update. "
+        "Reaches spec-scale global batches on few chips (BN statistics are "
+        "per-micro-batch, like torch DDP without cross-step SyncBN)",
+    )
+    parser.add_argument(
         "--image-size",
         type=int,
         default=32,

@@ -67,6 +67,11 @@ def _raw_split(hparams, split: str) -> tuple[np.ndarray, np.ndarray]:
             seed=hparams.seed + (split == "test"),
             anchor_seed=hparams.seed,
         )
+    if getattr(hparams, "image_size", 32) not in (0, 32):
+        raise ValueError(
+            "--image-size applies only to --synthetic-data "
+            "(CIFAR-100 images are 32x32)"
+        )
     if hparams.dset != "cifar100":
         raise ValueError(f"unknown dataset {hparams.dset!r}")
     images, labels = load_cifar100(hparams.dpath, split)

@@ -51,7 +51,8 @@ def create_train_state(
 
     variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
     params = variables["params"]
-    batch_stats = variables["batch_stats"]
+    # models without BatchNorm have no batch_stats collection
+    batch_stats = variables.get("batch_stats", {})
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

@@ -56,36 +56,86 @@ def _topk_hits(logits: jnp.ndarray, labels: jnp.ndarray) -> tuple[jnp.ndarray, j
 
 
 def _make_step_core(
-    precision: str, augment: bool, mean, std
+    precision: str, augment: bool, mean, std, grad_accum: int = 1
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """The shared train core: augment → normalize → fwd/bwd → SGD update.
 
-    Used by both the per-step path (``make_train_step``) and the scanned
-    epoch path (``make_epoch_runner``) so the two can never diverge.
+    Used by the per-step path (``make_train_step``), the scanned epoch path
+    (``make_epoch_runner``) and the chunked streaming path
+    (``make_chunk_runner``) so they can never diverge.
+
+    ``grad_accum > 1`` splits the batch into that many sequential
+    micro-batches, averages their gradients, and applies ONE optimizer
+    update — peak activation memory scales with the micro-batch, so
+    spec-scale global batches fit on few chips.  Gradient averaging is
+    exact (mean of micro-grads == grad of mean loss); BatchNorm statistics
+    are computed per micro-batch (the same semantics torch DDP has without
+    cross-accumulation SyncBN).
     """
     compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
-    def core(state: TrainState, images, labels, key: jax.Array):
+    def forward_backward(params, apply_fn, batch_stats, images, labels, key):
         if augment:
             images = random_crop_flip(images, key)
         x = normalize_images(images, mean, std, dtype=compute_dtype)
 
-        def loss_fn(params):
-            logits, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
+        def loss_fn(p):
+            logits, mutated = apply_fn(
+                {"params": p, "batch_stats": batch_stats},
                 x,
                 train=True,
                 mutable=["batch_stats"],
             )
             return _cross_entropy(logits, labels).mean(), (logits, mutated)
 
-        (loss, (logits, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
-        state = state.apply_gradients(grads=grads, batch_stats=mutated["batch_stats"])
+        (loss, (logits, mutated)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
         top1, _ = _topk_hits(logits, labels)
-        metrics = {"loss": loss, "top1_count": top1.sum(), "count": labels.size}
-        return state, metrics
+        # BN-free models mutate nothing; keep the (empty) stats tree stable
+        new_stats = mutated.get("batch_stats", batch_stats)
+        return grads, new_stats, loss, top1.sum()
+
+    def core(state: TrainState, images, labels, key: jax.Array):
+        if grad_accum <= 1:
+            grads, new_stats, loss, top1_count = forward_backward(
+                state.params, state.apply_fn, state.batch_stats, images, labels, key
+            )
+            state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+            return state, {
+                "loss": loss,
+                "top1_count": top1_count,
+                "count": labels.size,
+            }
+
+        a = grad_accum
+        b = images.shape[0]
+        micro_images = images.reshape(a, b // a, *images.shape[1:])
+        micro_labels = labels.reshape(a, b // a)
+        micro_keys = jax.random.split(key, a)
+
+        def micro_step(carry, inp):
+            grads_sum, batch_stats = carry
+            bx, by, k = inp
+            grads, new_stats, loss, top1_count = forward_backward(
+                state.params, state.apply_fn, batch_stats, bx, by, k
+            )
+            grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
+            return (grads_sum, new_stats), {"loss": loss, "top1": top1_count}
+
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        (grads_sum, final_stats), stacked = jax.lax.scan(
+            micro_step,
+            (zero_grads, state.batch_stats),
+            (micro_images, micro_labels, micro_keys),
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / a, grads_sum)
+        state = state.apply_gradients(grads=grads, batch_stats=final_stats)
+        return state, {
+            "loss": stacked["loss"].mean(),
+            "top1_count": stacked["top1"].sum(),
+            "count": labels.size,
+        }
 
     return core
 
@@ -98,6 +148,7 @@ def make_train_step(
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
     state_sharding=None,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """Build the compiled ``(state, images_u8, labels, key) -> (state, metrics)``.
 
@@ -112,7 +163,7 @@ def make_train_step(
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std)
+    core = _make_step_core(precision, augment, mean, std, grad_accum)
 
     # No buffer donation: the AsyncCheckpointer may still be fetching the
     # previous state while the next step runs (see async_ckpt.py); the cost
@@ -219,6 +270,7 @@ def make_chunk_runner(
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
     state_sharding=None,
+    grad_accum: int = 1,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """K loader steps as ONE compiled ``lax.scan`` dispatch (host streaming).
 
@@ -237,7 +289,7 @@ def make_chunk_runner(
     chunk_shard = batch_sharding(mesh, axis=1)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std)
+    core = _make_step_core(precision, augment, mean, std, grad_accum)
 
     def run(state: TrainState, images, labels, epoch_key: jax.Array, start):
         def body(state, inp):
@@ -264,6 +316,7 @@ def make_epoch_runner(
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
     state_sharding=None,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -276,7 +329,7 @@ def make_epoch_runner(
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std)
+    core = _make_step_core(precision, augment, mean, std, grad_accum)
 
     def run(state: TrainState, images, labels, key: jax.Array, epoch):
         n = images.shape[0]

@@ -80,10 +80,15 @@ class Trainer:
             hparams.num_devices, hparams.model_parallel, backend=hparams.backend
         )
         n_data = self.mesh.shape["data"]
-        if hparams.batch_size % n_data:
+        self.grad_accum = max(1, getattr(hparams, "grad_accum", 1))
+        if hparams.batch_size % (self.grad_accum * n_data):
+            detail = (
+                f"grad_accum ({self.grad_accum}) x data-parallel size ({n_data})"
+                if self.grad_accum > 1
+                else f"data-parallel size {n_data}"
+            )
             raise ValueError(
-                f"global batch {hparams.batch_size} not divisible by data-parallel "
-                f"size {n_data}"
+                f"global batch {hparams.batch_size} not divisible by {detail}"
             )
 
         self.root_key = fix_seed(hparams.seed)
@@ -99,6 +104,7 @@ class Trainer:
             dtype=compute_dtype,
             norm_dtype=norm_dtype,
             stem=getattr(hparams, "stem", "cifar"),
+            remat=getattr(hparams, "remat", False),
         )
 
         # --- data.  'device' mode: split is HBM-resident and replicated;
@@ -168,6 +174,7 @@ class Trainer:
                 hparams.batch_size,
                 precision=self.precision,
                 state_sharding=self.state_sharding,
+                grad_accum=self.grad_accum,
             )
             self.chunk_runner = None
         else:
@@ -176,6 +183,7 @@ class Trainer:
                 self.mesh,
                 precision=self.precision,
                 state_sharding=self.state_sharding,
+                grad_accum=self.grad_accum,
             )
         # whole-split scanned eval: one dispatch per validate()/test() call
         # (one executable per split shape), matching the train path's

@@ -33,5 +33,8 @@ def enable_persistent_compilation_cache(path: str | os.PathLike | None = None) -
     Path(cache_dir).mkdir(parents=True, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # default threshold (1 s) skips small programs; the dispatch-heavy ones
-    # here (eval runners, chunk runners at several sizes) are all worth it
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # here (eval runners, chunk runners at several sizes) are all worth it.
+    # An explicit JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS wins, like the
+    # cache-dir env var above.
+    if not os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
