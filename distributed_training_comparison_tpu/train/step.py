@@ -56,7 +56,12 @@ def _topk_hits(logits: jnp.ndarray, labels: jnp.ndarray) -> tuple[jnp.ndarray, j
 
 
 def _make_step_core(
-    precision: str, augment: bool, mean, std, grad_accum: int = 1
+    precision: str,
+    augment: bool,
+    mean,
+    std,
+    grad_accum: int = 1,
+    accum_sharding=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """The shared train core: augment → normalize → fwd/bwd → SGD update.
 
@@ -112,6 +117,17 @@ def _make_step_core(
         b = images.shape[0]
         micro_images = images.reshape(a, b // a, *images.shape[1:])
         micro_labels = labels.reshape(a, b // a)
+        if accum_sharding is not None:
+            # pin each micro-batch to the data axis: GSPMD otherwise
+            # resolves the unconstrained reshape by REPLICATING every
+            # micro-batch to all devices — each chip would redundantly
+            # compute the full micro-batch and data parallelism is lost
+            micro_images = jax.lax.with_sharding_constraint(
+                micro_images, accum_sharding
+            )
+            micro_labels = jax.lax.with_sharding_constraint(
+                micro_labels, accum_sharding
+            )
         micro_keys = jax.random.split(key, a)
 
         def micro_step(carry, inp):
@@ -163,7 +179,9 @@ def make_train_step(
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, batch_sharding(mesh, axis=1)
+    )
 
     # No buffer donation: the AsyncCheckpointer may still be fetching the
     # previous state while the next step runs (see async_ckpt.py); the cost
@@ -289,7 +307,9 @@ def make_chunk_runner(
     chunk_shard = batch_sharding(mesh, axis=1)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, batch_sharding(mesh, axis=1)
+    )
 
     def run(state: TrainState, images, labels, epoch_key: jax.Array, start):
         def body(state, inp):
@@ -329,7 +349,9 @@ def make_epoch_runner(
     data_shard = batch_sharding(mesh)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, batch_sharding(mesh, axis=1)
+    )
 
     def run(state: TrainState, images, labels, key: jax.Array, epoch):
         n = images.shape[0]

@@ -80,7 +80,9 @@ class Trainer:
             hparams.num_devices, hparams.model_parallel, backend=hparams.backend
         )
         n_data = self.mesh.shape["data"]
-        self.grad_accum = max(1, getattr(hparams, "grad_accum", 1))
+        self.grad_accum = getattr(hparams, "grad_accum", 1) or 1
+        if self.grad_accum < 1:
+            raise ValueError(f"--grad-accum must be >= 1, got {self.grad_accum}")
         if hparams.batch_size % (self.grad_accum * n_data):
             detail = (
                 f"grad_accum ({self.grad_accum}) x data-parallel size ({n_data})"

@@ -1,0 +1,21 @@
+#!/bin/sh
+# Multi-host launcher: run THIS script once on EVERY host of the slice
+# (e.g. via `gcloud compute tpus tpu-vm ssh --worker=all --command=...`).
+#
+# Reference analogue: src/ddp/run_ddp.sh + mp.spawn, except there is no
+# per-device process fork — one process per HOST drives all its local
+# chips, and jax.distributed.initialize (parallel/dist.py) replaces
+# init_process_group.  Set three environment variables per host:
+#
+#   WORLD_SIZE  total number of hosts           (default 1)
+#   RANK        this host's index, 0-based      (default 0)
+#   DIST_URL    coordinator, host0's "ip:port"  (default 127.0.0.1:3456)
+#
+# On Cloud TPU pod slices jax can usually auto-discover all three; the
+# flags exist for parity with the reference's CLI and for other fabrics.
+# The north-star recipe itself lives in run_tpu.sh — one copy only.
+exec sh "$(dirname "$0")/run_tpu.sh" \
+  --world-size "${WORLD_SIZE:-1}" \
+  --rank "${RANK:-0}" \
+  --dist-url "${DIST_URL:-127.0.0.1:3456}" \
+  "$@"

@@ -318,3 +318,17 @@ def test_grad_accum_with_bn_trains(mesh, tiny_data):
         jax.device_get(new_state.batch_stats),
     )
     assert max(jax.tree_util.tree_leaves(bdiff)) > 0
+
+
+def test_grad_accum_keeps_data_parallel_sharding(mesh, tiny_data):
+    """Micro-batches must stay sharded on the data axis: an unconstrained
+    (b,)→(a, b/a) reshape makes GSPMD replicate each micro-batch to every
+    device (each chip redundantly computing all of it).  With real data
+    parallelism the compiled program must carry gradient all-reduces."""
+    x, y = tiny_data
+    shard = batch_sharding(mesh)
+    state = _fresh_state(mesh)
+    step = make_train_step(mesh, augment=False, grad_accum=2)
+    bx, by = jax.device_put(x[:64], shard), jax.device_put(y[:64], shard)
+    compiled = step.lower(state, bx, by, jax.random.key(1)).compile()
+    assert "all-reduce" in compiled.as_text()
