@@ -177,11 +177,10 @@ def make_train_step(
     ``None`` the state is fully replicated (pure data parallelism).
     """
     data_shard = batch_sharding(mesh)
+    accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(
-        precision, augment, mean, std, grad_accum, batch_sharding(mesh, axis=1)
-    )
+    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard)
 
     # No buffer donation: the AsyncCheckpointer may still be fetching the
     # previous state while the next step runs (see async_ckpt.py); the cost
@@ -307,9 +306,7 @@ def make_chunk_runner(
     chunk_shard = batch_sharding(mesh, axis=1)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(
-        precision, augment, mean, std, grad_accum, batch_sharding(mesh, axis=1)
-    )
+    core = _make_step_core(precision, augment, mean, std, grad_accum, chunk_shard)
 
     def run(state: TrainState, images, labels, epoch_key: jax.Array, start):
         def body(state, inp):
@@ -347,11 +344,10 @@ def make_epoch_runner(
     train loader (``src/single/dataset.py:97``).
     """
     data_shard = batch_sharding(mesh)
+    accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(
-        precision, augment, mean, std, grad_accum, batch_sharding(mesh, axis=1)
-    )
+    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard)
 
     def run(state: TrainState, images, labels, key: jax.Array, epoch):
         n = images.shape[0]

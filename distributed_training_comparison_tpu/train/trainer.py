@@ -80,7 +80,8 @@ class Trainer:
             hparams.num_devices, hparams.model_parallel, backend=hparams.backend
         )
         n_data = self.mesh.shape["data"]
-        self.grad_accum = getattr(hparams, "grad_accum", 1) or 1
+        ga = getattr(hparams, "grad_accum", 1)
+        self.grad_accum = 1 if ga is None else ga
         if self.grad_accum < 1:
             raise ValueError(f"--grad-accum must be >= 1, got {self.grad_accum}")
         if hparams.batch_size % (self.grad_accum * n_data):

@@ -1,0 +1,26 @@
+#!/bin/sh
+# Elastic supervisor: relaunch training after crashes, resuming in place.
+#
+# The reference quotes torchelastic as its unimplemented "step 4"
+# (README.md:11,14 — SURVEY.md §5 "failure detection / elastic recovery:
+# none").  Here recovery is two existing primitives composed: every epoch
+# writes a resumable last.ckpt, and --auto-resume continues the newest
+# interrupted run in its own version dir.  This wrapper adds the restart
+# loop: rerun the same command until it exits cleanly, up to MAX_RESTARTS
+# (default 5).  A FloatingPointError abort (diverged run, exit code != 0)
+# also stops retrying once the budget is exhausted — restarts cannot fix
+# divergence, only crashes.
+MAX_RESTARTS="${MAX_RESTARTS:-5}"
+
+restarts=0
+while :; do
+    sh "$(dirname "$0")/run_tpu.sh" --auto-resume "$@" && exit 0
+    rc=$?
+    if [ "$restarts" -ge "$MAX_RESTARTS" ]; then
+        echo "run_elastic: giving up after ${restarts} restarts (last rc=${rc})" >&2
+        exit "$rc"
+    fi
+    restarts=$((restarts + 1))
+    echo "run_elastic: run failed (rc=${rc}); restart ${restarts}/${MAX_RESTARTS} with --auto-resume" >&2
+    sleep 2
+done

@@ -11,8 +11,9 @@
 #   RANK        this host's index, 0-based      (default 0)
 #   DIST_URL    coordinator, host0's "ip:port"  (default 127.0.0.1:3456)
 #
-# On Cloud TPU pod slices jax can usually auto-discover all three; the
-# flags exist for parity with the reference's CLI and for other fabrics.
+# All three MUST be set on a real slice: with the default WORLD_SIZE=1
+# each host silently trains alone (init_distributed skips the rendezvous
+# when world_size <= 1 — parallel/dist.py).
 # The north-star recipe itself lives in run_tpu.sh — one copy only.
 exec sh "$(dirname "$0")/run_tpu.sh" \
   --world-size "${WORLD_SIZE:-1}" \

@@ -65,3 +65,38 @@ def test_sharded_mean_is_global_mean():
 
 def test_host_local_batch_slice_single_host():
     assert host_local_batch_slice(256) == 256  # one process in CI
+
+
+def test_remat_tp_grad_accum_compose():
+    """remat (nn.remat-wrapped blocks), tensor parallelism (name-keyed
+    partition specs) and gradient accumulation must work together: remat
+    preserves flax module naming, so TP specs still land, and the composed
+    step compiles and runs on a (4,2) mesh."""
+    import numpy as np
+    from distributed_training_comparison_tpu import parallel
+    from distributed_training_comparison_tpu.models.resnet import BasicBlock, ResNet
+    from distributed_training_comparison_tpu.train import (
+        configure_optimizers,
+        create_train_state,
+        make_train_step,
+    )
+
+    class HP:
+        lr, weight_decay = 0.1, 1e-4
+        lr_decay_step_size, lr_decay_gamma = 25, 0.1
+
+    model = ResNet(block=BasicBlock, num_blocks=(0, 0, 1, 1), num_classes=10, remat=True)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+    state = create_train_state(model, jax.random.key(0), tx)
+    mesh = parallel.make_mesh(8, 2, backend="tpu")
+    sharding = parallel.state_shardings(mesh, state)
+    state = parallel.place_tree(state, sharding)
+    k = state.params["stage3_block0"]["Conv_0"]["kernel"]
+    assert not k.sharding.is_fully_replicated  # TP survived remat naming
+    step = make_train_step(mesh, precision="bf16", state_sharding=sharding, grad_accum=2)
+    bx, by = parallel.shard_batch(
+        (np.zeros((16, 32, 32, 3), np.uint8), np.zeros((16,), np.int32)), mesh
+    )
+    st2, metrics = step(state, bx, by, jax.random.key(1))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(jax.device_get(st2.step)) == 1
