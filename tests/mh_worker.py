@@ -103,6 +103,14 @@ def main(rank: int, port: int) -> None:
     gathered = fetch_to_host(sharded)  # partitioned → all-gather, symmetric
     assert np.array_equal(gathered, gvals), gathered
 
+    # chunked host-streaming layout (K, B, ...) assembles across processes
+    # with the batch on axis 1 (shard_batch(batch_axis=1) multi-host branch)
+    gchunk = np.arange(2 * 32, dtype=np.float32).reshape(2, 32)
+    local_chunk = gchunk[:, rank * 16 : (rank + 1) * 16]
+    chunk_arr = parallel.shard_batch(local_chunk, mesh, batch_axis=1)
+    assert chunk_arr.shape == (2, 32), chunk_arr.shape
+    assert np.array_equal(fetch_to_host(chunk_arr), gchunk)
+
     # the test() broadcast pattern (train/trainer.py): process-0's params win
     from jax.experimental import multihost_utils
 
