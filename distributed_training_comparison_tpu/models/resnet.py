@@ -78,6 +78,10 @@ class BasicBlock(nn.Module):
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
             dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            # flax force-promotes stat reductions to fp32 by default, which
+            # would silently neuter norm_dtype=None ("reduce in compute
+            # dtype"); only the explicit-fp32 mode keeps the promotion
+            force_float32_reductions=self.norm_dtype is not None,
         )
         out = Conv3x3(self.planes, strides=self.stride, dtype=self.dtype)(x)
         out = norm()(out)
@@ -112,6 +116,10 @@ class Bottleneck(nn.Module):
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
             dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            # flax force-promotes stat reductions to fp32 by default, which
+            # would silently neuter norm_dtype=None ("reduce in compute
+            # dtype"); only the explicit-fp32 mode keeps the promotion
+            force_float32_reductions=self.norm_dtype is not None,
         )
         out = Conv1x1(self.planes, strides=1, dtype=self.dtype)(x)
         out = norm()(out)
@@ -177,6 +185,7 @@ class ResNet(nn.Module):
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
             dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            force_float32_reductions=self.norm_dtype is not None,
             name="stem_bn",
         )(x)
         x = nn.relu(x)

@@ -48,6 +48,7 @@ __all__ = [
     "place_tree",
     "fetch_to_host",
     "needs_collective_fetch",
+    "host_local_batch_slice",
     "param_partition_specs",
     "batch_stats_partition_specs",
     "state_shardings",

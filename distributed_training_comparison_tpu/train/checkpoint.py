@@ -65,8 +65,6 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
     full state lives in ``last.ckpt``.
     """
     version_dir = Path(version_dir)
-    for old in version_dir.glob(f"{BEST_PREFIX}*.ckpt"):
-        old.unlink()
     payload = {
         "params": serialization.to_state_dict(fetch_to_host(state.params)),
         "batch_stats": serialization.to_state_dict(fetch_to_host(state.batch_stats)),
@@ -77,6 +75,12 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
     tmp = path.with_suffix(".tmp")  # atomic-ish, like save_resume_state
     tmp.write_bytes(serialization.msgpack_serialize(payload))
     tmp.replace(path)
+    # drop superseded best files only AFTER the new one is durably in place
+    # — a crash mid-save (fetch can take seconds) must never leave the
+    # version dir with zero best checkpoints
+    for old in version_dir.glob(f"{BEST_PREFIX}*.ckpt"):
+        if old != path:
+            old.unlink()
     return path
 
 

@@ -333,8 +333,13 @@ class Trainer:
                 bad = int(np.argmin(np.isfinite(losses)))
                 if self.ckpt_writer is not None:
                     # drain in-flight best/last writes: the daemon writer
-                    # must not die mid-save when the exception exits
-                    self.ckpt_writer.wait()
+                    # must not die mid-save when the exception exits.  A
+                    # failed earlier write is logged but must not replace
+                    # the divergence diagnostics below.
+                    try:
+                        self.ckpt_writer.wait()
+                    except Exception as e:
+                        self.logger.error(f"checkpoint writer error: {e}")
                 last_good = (
                     self.version_dir / ckpt.LAST_NAME
                     if self.version_dir is not None
