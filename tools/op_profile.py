@@ -1,0 +1,76 @@
+"""Summarize a ``--profile-dir`` trace: where device time goes.
+
+Usage::
+
+    python tools/op_profile.py /path/to/profile_dir
+
+Reads the ``*.xplane.pb`` a training run wrote under
+``--profile-dir`` (one steady-state epoch, ``train/trainer.py``) and
+prints the per-category device-time breakdown with FLOP and HBM-bandwidth
+utilization — the numbers that say whether a config is compute- or
+memory-bound.  Uses the tensorflow profiler's converter when available
+(dev extra; see requirements-dev.txt).
+
+Reference has no profiling at all (SURVEY.md §5); this closes the loop on
+the capture side's ``--profile-dir``.
+
+Example (ResNet-18/bs256/bf16 on one v5e): convolution fusions are ~85% of
+non-idle device time at ~0.51 HBM utilization — the 32×32 workload is
+partly memory-bound, so the measured 59.5% MFU is near the practical
+ceiling for this architecture on this chip.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import sys
+
+
+def summarize(profile_dir: str, top: int = 12) -> None:
+    paths = sorted(
+        glob.glob(f"{profile_dir}/**/*.xplane.pb", recursive=True)
+    )
+    if not paths:
+        raise SystemExit(f"no *.xplane.pb under {profile_dir}")
+    try:
+        from tensorflow.python.profiler.internal import (  # noqa: PLC0415
+            _pywrap_profiler_plugin as pp,
+        )
+    except ImportError:
+        raise SystemExit(
+            "tensorflow (dev extra) is required to parse xplane traces; "
+            "pip install -r requirements-dev.txt"
+        )
+    raw, ok = pp.xspace_to_tools_data([paths[-1]], "op_profile", {})
+    if not ok:
+        raise SystemExit(
+            f"trace conversion failed for {paths[-1]} — was the run killed "
+            "before the profiler flushed?"
+        )
+    d = json.loads(raw)
+    root = d["byCategoryExcludeIdle"]
+    total = root["metrics"]["rawTime"] or 1
+
+    print(f"trace: {paths[-1]}")
+    print(f"device: {d.get('deviceType', '?')}  (idle time excluded)")
+    print(f"{'time':>7}  {'FLOP util':>9}  {'HBM util':>8}  category")
+    rows = sorted(
+        root.get("children", []),
+        key=lambda c: c["metrics"]["rawTime"],
+        reverse=True,
+    )
+    for c in rows[:top]:
+        m = c["metrics"]
+        share = 100.0 * m["rawTime"] / total
+        if share < 0.05:
+            continue
+        hbm = (m.get("bandwidthUtils") or [0])[0]
+        print(
+            f"{share:6.1f}%  {100 * m.get('flops', 0):8.1f}%  "
+            f"{100 * hbm:7.1f}%  {c.get('name', '?')}"
+        )
+
+
+if __name__ == "__main__":
+    summarize(sys.argv[1] if len(sys.argv) > 1 else "profile")
