@@ -35,34 +35,34 @@ def main() -> None:
     )
     parser.add_argument("--csv", default="result.csv")
     args, passthrough = parser.parse_known_args()
-    if passthrough and passthrough[0] == "--":
-        passthrough = passthrough[1:]
+    if "--" in passthrough:  # drop the first separator wherever argparse left it
+        passthrough.remove("--")
 
     from distributed_training_comparison_tpu import entry
 
+    csv_path = Path(args.csv)
     rows = []
     for seed in args.seeds:
         argv = [*passthrough, "--seed", str(seed), "--contain-test"]
         print(f"=== {args.backend} seed {seed}: {' '.join(argv)}", flush=True)
         res = entry.run(args.backend, argv)
-        rows.append(
-            {
-                "backend": args.backend,
-                "seed": seed,
-                "version": res.get("version"),
-                "test_loss": res["test_loss"],
-                "test_top1": res["test_top1"],
-                "test_top5": res["test_top5"],
-            }
-        )
-
-    csv_path = Path(args.csv)
-    new_file = not csv_path.exists()
-    with csv_path.open("a", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=list(rows[0]))
-        if new_file:
-            w.writeheader()
-        w.writerows(rows)
+        row = {
+            "backend": args.backend,
+            "seed": seed,
+            "version": res.get("version"),
+            "test_loss": res["test_loss"],
+            "test_top1": res["test_top1"],
+            "test_top5": res["test_top5"],
+        }
+        rows.append(row)
+        # append immediately: a crash on a later seed must not discard
+        # completed seeds' results (each seed is minutes-to-hours of work)
+        new_file = not csv_path.exists()
+        with csv_path.open("a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(row))
+            if new_file:
+                w.writeheader()
+            w.writerow(row)
 
     def mean(k):
         return statistics.fmean(r[k] for r in rows)

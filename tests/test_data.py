@@ -287,6 +287,21 @@ def test_load_cifar100_pickle_roundtrip(fake_cifar_dir):
     )
 
 
+def test_tarball_auto_extraction(fake_cifar_dir, tmp_path):
+    """Dropping the official cifar-100-python.tar.gz in --dpath must be
+    enough: the loader extracts it and reads the pickles."""
+    import tarfile
+
+    tar_dir = tmp_path / "tardrop"
+    tar_dir.mkdir()
+    with tarfile.open(tar_dir / "cifar-100-python.tar.gz", "w:gz") as t:
+        t.add(fake_cifar_dir / "cifar-100-python", arcname="cifar-100-python")
+    x, y = load_cifar100(tar_dir, "train")
+    assert x.shape == (20, 32, 32, 3) and y.shape == (20,)
+    # extraction is one-time: the extracted dir now exists alongside the tar
+    assert (tar_dir / "cifar-100-python" / "train").is_file()
+
+
 def test_npz_cache_roundtrip(fake_cifar_dir):
     x0, y0 = load_cifar100(fake_cifar_dir, "test")
     save_npz_cache(fake_cifar_dir)
