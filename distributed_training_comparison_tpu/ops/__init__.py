@@ -1,0 +1,17 @@
+"""Custom TPU ops (Pallas kernels) with reference implementations.
+
+The reference repo has no custom kernels at all — every hot op is delegated
+to cuDNN/ATen (SURVEY.md §2.3).  The TPU-native analogue of "a framework
+that owns its hot ops" is Pallas: each op here ships
+
+- a pure-jnp **reference** implementation (the semantics contract, runs
+  anywhere), and
+- a **Pallas TPU kernel** (the fast path), verified against the reference
+  in CI via interpret mode on the virtual CPU mesh.
+
+Dispatch helpers pick the kernel on TPU and the reference elsewhere.
+"""
+
+from .attention import attention, flash_attention, mha_reference
+
+__all__ = ["attention", "flash_attention", "mha_reference"]

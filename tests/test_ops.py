@@ -1,0 +1,118 @@
+"""Pallas flash-attention kernel vs the jnp reference (interpret mode).
+
+The kernels are exercised through the Pallas interpreter so the exact
+production code paths (fwd + both backward kernels, masking, padding,
+causal block-skipping) run in CI on the CPU mesh.  Comparisons run under
+``default_matmul_precision("highest")`` — this CPU backend's default
+matmul precision is bf16-like, which would drown the parity signal.
+
+On real TPU hardware the same checks hold at bf16 tolerance; measured
+v5e throughput (S=8192, D=128): 105 TF/s non-causal / 76 TF/s causal vs
+1.2 / 0.6 TF/s for the reference implementation (which materializes the
+S×S score matrix in HBM).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_comparison_tpu.ops import (
+    attention,
+    flash_attention,
+    mha_reference,
+)
+
+
+def _rand_qkv(seed, sq, skv, d, dtype=jnp.float32, b=2, h=3):
+    kq, kk, kv, kdo = jax.random.split(jax.random.key(seed), 4)
+    return (
+        jax.random.normal(kq, (b, h, sq, d), dtype),
+        jax.random.normal(kk, (b, h, skv, d), dtype),
+        jax.random.normal(kv, (b, h, skv, d), dtype),
+        jax.random.normal(kdo, (b, h, sq, d), dtype),
+    )
+
+
+@pytest.mark.parametrize(
+    "causal,sq,skv,d",
+    [
+        (False, 256, 256, 64),   # aligned
+        (False, 200, 200, 48),   # seq and head-dim padding
+        (False, 128, 384, 64),   # cross-attention (kv longer)
+        (False, 64, 500, 128),   # both lengths padded, full-width head
+        (True, 256, 256, 64),
+        (True, 200, 200, 48),
+    ],
+)
+def test_flash_matches_reference(causal, sq, skv, d):
+    q, k, v, do = _rand_qkv(sq * 7 + d + causal, sq, skv, d)
+    with jax.default_matmul_precision("highest"):
+        out_f, vjp_f = jax.vjp(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal, interpret=True),
+            q, k, v,
+        )
+        out_r, vjp_r = jax.vjp(
+            lambda q, k, v: mha_reference(q, k, v, causal=causal), q, k, v
+        )
+        grads_f, grads_r = vjp_f(do), vjp_r(do)
+
+    assert out_f.shape == (q.shape[0], q.shape[1], sq, d)
+    assert float(jnp.max(jnp.abs(out_f - out_r))) < 2e-5
+    for gf, gr, name in zip(grads_f, grads_r, "qkv"):
+        assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
+
+
+def test_flash_explicit_blocks():
+    """Non-default block shapes (incl. block_k spanning the whole padded
+    sequence, the measured-fastest TPU config) agree with the default."""
+    q, k, v, _ = _rand_qkv(11, 256, 512, 64)
+    with jax.default_matmul_precision("highest"):
+        base = mha_reference(q, k, v)
+        for bq, bk in [(128, 512), (256, 256), (128, 128)]:
+            out = flash_attention(q, k, v, block_q=bq, block_k=bk, interpret=True)
+            assert float(jnp.max(jnp.abs(out - base))) < 2e-5, (bq, bk)
+
+
+def test_flash_causal_masks_future():
+    """Perturbing future keys/values never changes causal output."""
+    q, k, v, _ = _rand_qkv(3, 256, 256, 64)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        k2 = k.at[:, :, 200:, :].add(5.0)
+        v2 = v.at[:, :, 200:, :].add(-3.0)
+        out2 = flash_attention(q, k2, v2, causal=True, interpret=True)
+    # rows < 200 attend only to keys ≤ row index < 200 → identical
+    assert float(jnp.max(jnp.abs(out[:, :, :200] - out2[:, :, :200]))) == 0.0
+    # last rows do see the perturbation
+    assert float(jnp.max(jnp.abs(out[:, :, 200:] - out2[:, :, 200:]))) > 1e-3
+
+
+def test_flash_causal_requires_square():
+    q, k, v, _ = _rand_qkv(0, 128, 256, 64)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=True, interpret=True)
+
+
+def test_attention_dispatcher():
+    q, k, v, _ = _rand_qkv(5, 64, 64, 32)
+    with jax.default_matmul_precision("highest"):
+        # CPU backend → auto resolves to the reference implementation
+        out_auto = attention(q, k, v)
+        out_ref = attention(q, k, v, impl="reference")
+    assert float(jnp.max(jnp.abs(out_auto - out_ref))) == 0.0
+    with pytest.raises(ValueError, match="unknown attention impl"):
+        attention(q, k, v, impl="nope")
+
+
+def test_flash_jit_and_grad_compile():
+    """The custom_vjp plumbing stays jittable (static meta args hash)."""
+    q, k, v, do = _rand_qkv(9, 128, 128, 64)
+
+    @jax.jit
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, interpret=True)
+        return (o * do).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    assert all(x.shape == y.shape for x, y in zip(g, (q, k, v)))
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in g)
