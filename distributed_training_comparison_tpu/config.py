@@ -92,7 +92,10 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--model",
         type=str,
         default="resnet18",
-        choices=["resnet18", "resnet34", "resnet50", "resnet101", "resnet152"],
+        choices=[
+            "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+            "vit_tiny", "vit_small",
+        ],
         help="Model zoo entry (live, unlike the reference's dead --model flag)",
     )
     parser.add_argument("--lr", type=float, default=0.1)

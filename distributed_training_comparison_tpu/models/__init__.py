@@ -17,6 +17,7 @@ from .resnet import (
     ResNet101,
     ResNet152,
 )
+from .vit import ViT, ViTBlock, ViTSmall, ViTTiny
 
 _ZOO = {
     "resnet18": ResNet18,
@@ -24,15 +25,18 @@ _ZOO = {
     "resnet50": ResNet50,
     "resnet101": ResNet101,
     "resnet152": ResNet152,
+    "vit_tiny": ViTTiny,
+    "vit_small": ViTSmall,
 }
 
 
-def get_model(name: str, **kwargs) -> ResNet:
-    """Build a zoo model by CLI name (e.g. ``"resnet18"``)."""
+def get_model(name: str, **kwargs):
+    """Build a zoo model by CLI name (e.g. ``"resnet18"``, ``"vit_tiny"``)."""
     try:
-        return _ZOO[name.lower()](**kwargs)
+        ctor = _ZOO[name.lower()]
     except KeyError:
         raise ValueError(f"unknown model {name!r}; choices: {sorted(_ZOO)}") from None
+    return ctor(**kwargs)
 
 
 __all__ = [
@@ -44,5 +48,9 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "ViT",
+    "ViTBlock",
+    "ViTTiny",
+    "ViTSmall",
     "get_model",
 ]

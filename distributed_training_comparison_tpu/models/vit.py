@@ -1,0 +1,169 @@
+"""Vision Transformer family (beyond parity: the reference is CNN-only).
+
+The reference's model zoo is the CIFAR ResNet family and nothing else
+(``src/single/net.py``; SURVEY.md §2.2: "no sequence dimension, no
+attention").  This transformer family gives the framework a sequence axis,
+which is what makes the long-context machinery real: attention runs
+through ``ops.attention`` (the Pallas flash kernel on TPU), and the
+sequence dimension is what ring attention (``parallel/ring.py``) and
+pipeline parallelism shard.
+
+TPU-native choices:
+
+- **Scanned trunk**: the ``depth`` identical pre-LN blocks are one
+  ``nn.scan`` over stacked parameters ``(depth, ...)`` — one block trace
+  instead of ``depth`` unrolled copies (faster compiles, and the stacked
+  leading axis is exactly what stage-sharded pipeline parallelism
+  partitions).
+- **bf16 policy** like the ResNet zoo: activations/matmuls in ``dtype``,
+  parameters fp32, LayerNorm statistics in fp32 by default (``norm_dtype``
+  mirrors the ResNet ``norm_dtype`` contract: ``None`` → reduce in the
+  compute dtype), fp32 logits.
+- **Global-average-pool head** (no class token): keeps the sequence
+  homogeneous — every token flows through the same scanned/sharded path.
+
+Shapes: CIFAR 32×32 with ``patch=4`` → 64 tokens.  ``stem`` is accepted
+for ``get_model`` interface compatibility and ignored (the patch embed is
+the stem).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dense = nn.Dense  # kernels xavier-init below where it matters
+
+
+class ViTBlock(nn.Module):
+    """Pre-LN transformer block, scan-compatible: ``(x, None) -> (x, None)``."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    norm_dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, _carry_in=None):
+        from functools import partial
+
+        from ..ops import attention
+
+        # same contract as the ResNet norms: flax force-promotes stat
+        # reductions to fp32 by default, which would silently neuter
+        # norm_dtype=None ("reduce in compute dtype")
+        norm = partial(
+            nn.LayerNorm,
+            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            force_float32_reductions=self.norm_dtype is not None,
+        )
+        b, s, dim = x.shape
+        hd = dim // self.heads
+
+        h = norm(name="ln_attn")(x).astype(self.dtype)
+        qkv = Dense(
+            3 * dim, dtype=self.dtype, name="qkv",
+            kernel_init=nn.initializers.xavier_uniform(),
+        )(h)
+        qkv = qkv.reshape(b, s, 3, self.heads, hd).transpose(2, 0, 3, 1, 4)
+        o = attention(qkv[0], qkv[1], qkv[2], impl=self.attn_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, dim)
+        x = x + Dense(
+            dim, dtype=self.dtype, name="proj",
+            kernel_init=nn.initializers.xavier_uniform(),
+        )(o)
+
+        h = norm(name="ln_mlp")(x).astype(self.dtype)
+        h = Dense(
+            self.mlp_ratio * dim, dtype=self.dtype, name="mlp_up",
+            kernel_init=nn.initializers.xavier_uniform(),
+        )(h)
+        h = nn.gelu(h)
+        x = x + Dense(
+            dim, dtype=self.dtype, name="mlp_down",
+            kernel_init=nn.initializers.xavier_uniform(),
+        )(h)
+        return x, None
+
+
+class ViT(nn.Module):
+    """Patch embed → ``depth`` scanned blocks → LN → mean pool → linear head."""
+
+    depth: int
+    dim: int
+    heads: int
+    patch: int = 4
+    mlp_ratio: int = 4
+    num_classes: int = 100
+    dtype: Any = jnp.float32
+    norm_dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+    remat: bool = False
+    stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.dim,
+            kernel_size=(self.patch, self.patch),
+            strides=self.patch,
+            padding=0,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="patch_embed",
+        )(x)
+        b, h, w, _ = x.shape
+        x = x.reshape(b, h * w, self.dim)
+        pos = self.param(
+            "pos_emb",
+            nn.initializers.normal(stddev=0.02),
+            (1, h * w, self.dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        block = ViTBlock
+        if self.remat:
+            block = nn.remat(block, prevent_cse=False)
+        x, _ = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=self.depth,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(
+            dim=self.dim,
+            heads=self.heads,
+            mlp_ratio=self.mlp_ratio,
+            dtype=self.dtype,
+            norm_dtype=self.norm_dtype,
+            attn_impl=self.attn_impl,
+            name="blocks",
+        )(x, None)
+
+        x = nn.LayerNorm(
+            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
+            force_float32_reductions=self.norm_dtype is not None,
+            name="ln_head",
+        )(x).astype(self.dtype)
+        x = jnp.mean(x, axis=1)
+        x = Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.xavier_uniform(),
+            name="head",
+        )(x)
+        return x.astype(jnp.float32)
+
+
+def ViTTiny(**kw) -> ViT:
+    return ViT(depth=12, dim=192, heads=3, **kw)
+
+
+def ViTSmall(**kw) -> ViT:
+    return ViT(depth=12, dim=384, heads=6, **kw)

@@ -1,0 +1,100 @@
+"""ViT family: architecture invariants + the BN-free end-to-end path.
+
+The reference zoo is CNN-only; the transformer family is beyond-parity, so
+there is no reference param-count to mirror — instead the count is checked
+against the closed-form architecture formula, and the trainer path is
+exercised end-to-end (a BN-free model must flow through the same scanned
+epoch/eval programs that carry ResNet's batch_stats)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu import models
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.models import ViT
+from distributed_training_comparison_tpu.train import Trainer
+
+
+def _param_count(depth, dim, heads, patch, num_classes, tokens, mlp_ratio=4):
+    patch_embed = patch * patch * 3 * dim + dim
+    pos = tokens * dim
+    per_block = (
+        2 * 2 * dim  # two LayerNorms (scale+bias)
+        + dim * 3 * dim + 3 * dim  # qkv
+        + dim * dim + dim  # proj
+        + dim * mlp_ratio * dim + mlp_ratio * dim  # mlp up
+        + mlp_ratio * dim * dim + dim  # mlp down
+    )
+    head = 2 * dim + dim * num_classes + num_classes  # ln_head + linear
+    return patch_embed + pos + depth * per_block + head
+
+
+@pytest.mark.parametrize("name,depth,dim,heads", [("vit_tiny", 12, 192, 3), ("vit_small", 12, 384, 6)])
+def test_param_count_matches_formula(name, depth, dim, heads):
+    m = models.get_model(name)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
+    n = sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
+    assert n == _param_count(depth, dim, heads, patch=4, num_classes=100, tokens=64)
+    assert "batch_stats" not in v  # transformer family is BN-free
+
+
+def test_scanned_trunk_stacks_params():
+    """The trunk is one nn.scan: every block param carries a (depth, ...)
+    leading axis — the axis pipeline parallelism shards."""
+    m = models.get_model("vit_tiny")
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
+    blocks = v["params"]["blocks"]
+    for leaf in jax.tree_util.tree_leaves(blocks):
+        assert leaf.shape[0] == 12
+
+
+def test_bf16_policy_keeps_params_and_logits_fp32():
+    m = models.get_model("vit_tiny", dtype=jnp.bfloat16)
+    v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
+    assert all(
+        x.dtype == jnp.float32 for x in jax.tree_util.tree_leaves(v["params"])
+    )
+    out = m.apply(v, jnp.zeros((2, 32, 32, 3), jnp.float32), train=False)
+    assert out.shape == (2, 100) and out.dtype == jnp.float32
+
+
+def test_remat_preserves_forward():
+    kw = dict(depth=2, dim=32, heads=2, patch=8)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3), jnp.float32)
+    base = ViT(**kw)
+    v = base.init(jax.random.key(0), x, train=False)
+    out = base.apply(v, x, train=False)
+    out_r = ViT(remat=True, **kw).apply(v, x, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_r), atol=1e-6)
+
+
+def test_trainer_end_to_end_vit(tmp_path):
+    """fit → validate → test through the scanned SPMD programs with an
+    (empty) batch_stats collection."""
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data",
+            "--limit-examples", "256",
+            "--batch-size", "64",
+            "--epoch", "2",
+            "--lr", "0.01",
+            "--model", "vit_tiny",  # name only; tiny stand-in passed below
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    t = Trainer(hp, model=ViT(depth=2, dim=32, heads=2, patch=8))
+    version = t.fit()
+    results = t.test()
+    t.close()
+    assert version == 0
+    assert (tmp_path / "version-0" / "last.ckpt").exists()
+    assert 0.0 <= results["test_top1"] <= results["test_top5"] <= 100.0
+    assert np.isfinite(results["test_loss"])
+
+
+def test_config_accepts_vit_models():
+    hp = load_config("tpu", argv=["--model", "vit_small", "--synthetic-data"])
+    assert hp.model == "vit_small"
