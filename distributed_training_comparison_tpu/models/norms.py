@@ -1,0 +1,25 @@
+"""The zoo's shared normalization-dtype policy.
+
+One place owns the ``norm_dtype`` contract for every norm layer (ResNet
+BatchNorms, ViT LayerNorms): fp32 stat reductions by default under any
+compute dtype, or ``norm_dtype=None`` to reduce in the compute dtype (the
+measurable comparison mode, ``--bn-dtype compute``).  flax force-promotes
+stat reductions to fp32 by default, which would silently neuter the
+``None`` mode — so ``force_float32_reductions`` must track the policy;
+centralizing it here keeps the five norm call sites from drifting.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+
+def norm_policy(norm_cls, norm_dtype: Any, dtype: Any, **fixed) -> partial:
+    """Bind a flax norm class to the zoo's stat-reduction dtype contract."""
+    return partial(
+        norm_cls,
+        dtype=norm_dtype if norm_dtype is not None else dtype,
+        force_float32_reductions=norm_dtype is not None,
+        **fixed,
+    )

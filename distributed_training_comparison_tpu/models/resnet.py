@@ -39,6 +39,8 @@ from typing import Any, Callable, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .norms import norm_policy
+
 # torch BatchNorm2d defaults: eps=1e-5, running-stat update factor 0.1
 # (flax `momentum` is the *decay* of the running stat: 1 - 0.1).
 BN_MOMENTUM = 0.9
@@ -72,16 +74,13 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
-        norm = partial(
+        norm = norm_policy(
             nn.BatchNorm,
+            self.norm_dtype,
+            self.dtype,
             use_running_average=not train,
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
-            # flax force-promotes stat reductions to fp32 by default, which
-            # would silently neuter norm_dtype=None ("reduce in compute
-            # dtype"); only the explicit-fp32 mode keeps the promotion
-            force_float32_reductions=self.norm_dtype is not None,
         )
         out = Conv3x3(self.planes, strides=self.stride, dtype=self.dtype)(x)
         out = norm()(out)
@@ -110,16 +109,13 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool) -> jnp.ndarray:
-        norm = partial(
+        norm = norm_policy(
             nn.BatchNorm,
+            self.norm_dtype,
+            self.dtype,
             use_running_average=not train,
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
-            # flax force-promotes stat reductions to fp32 by default, which
-            # would silently neuter norm_dtype=None ("reduce in compute
-            # dtype"); only the explicit-fp32 mode keeps the promotion
-            force_float32_reductions=self.norm_dtype is not None,
         )
         out = Conv1x1(self.planes, strides=1, dtype=self.dtype)(x)
         out = norm()(out)
@@ -180,14 +176,14 @@ class ResNet(nn.Module):
             )(x)
         else:
             x = Conv3x3(64, strides=1, dtype=self.dtype, name="stem_conv")(x)
-        x = nn.BatchNorm(
+        x = norm_policy(
+            nn.BatchNorm,
+            self.norm_dtype,
+            self.dtype,
             use_running_average=not train,
             momentum=BN_MOMENTUM,
             epsilon=BN_EPS,
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
-            force_float32_reductions=self.norm_dtype is not None,
-            name="stem_bn",
-        )(x)
+        )(name="stem_bn")(x)
         x = nn.relu(x)
         if self.stem == "imagenet":
             x = nn.max_pool(

@@ -49,18 +49,10 @@ class ViTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, _carry_in=None):
-        from functools import partial
-
         from ..ops import attention
+        from .norms import norm_policy
 
-        # same contract as the ResNet norms: flax force-promotes stat
-        # reductions to fp32 by default, which would silently neuter
-        # norm_dtype=None ("reduce in compute dtype")
-        norm = partial(
-            nn.LayerNorm,
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
-            force_float32_reductions=self.norm_dtype is not None,
-        )
+        norm = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)
         b, s, dim = x.shape
         hd = dim // self.heads
 
@@ -146,10 +138,10 @@ class ViT(nn.Module):
             name="blocks",
         )(x, None)
 
-        x = nn.LayerNorm(
-            dtype=self.norm_dtype if self.norm_dtype is not None else self.dtype,
-            force_float32_reductions=self.norm_dtype is not None,
-            name="ln_head",
+        from .norms import norm_policy
+
+        x = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)(
+            name="ln_head"
         )(x).astype(self.dtype)
         x = jnp.mean(x, axis=1)
         x = Dense(

@@ -61,9 +61,15 @@ def mha_reference(
     *,
     causal: bool = False,
     scale: float | None = None,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+):
     """Plain (B, H, S, D) attention; softmax in fp32.  The semantics
-    contract the Pallas kernel is tested against."""
+    contract the Pallas kernel is tested against.
+
+    ``return_lse=True`` additionally returns the per-row log-sum-exp of the
+    scaled scores, (B, H, S) fp32 — the statistic ring attention needs to
+    combine partial results across key/value shards exactly.
+    """
     d = q.shape[-1]
     scale = 1.0 / math.sqrt(d) if scale is None else scale
     s = jnp.einsum(
@@ -77,8 +83,10 @@ def mha_reference(
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
-    )
-    return out.astype(q.dtype)
+    ).astype(q.dtype)
+    if return_lse:
+        return out, jax.nn.logsumexp(s, axis=-1)
+    return out
 
 
 # ---------------------------------------------------------- kernel helpers
@@ -175,7 +183,7 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref, dq_ref,
     *, scale, causal, block_k, kv_len,
 ):
     block_q, d = q_ref.shape
@@ -183,7 +191,9 @@ def _dq_kernel(
     qb = q_ref[...]
     dob = do_ref[...]
     lse_row = lse_ref[:, 0:1]
-    delta_row = delta_ref[:, 0:1]
+    # d(loss)/d(scores) = p·(dp - delta) from the out cotangent, plus p·dlse
+    # from the lse cotangent (d lse / d scores = p) — fold both row terms
+    adj_row = dlse_ref[:, 0:1] - delta_ref[:, 0:1]
     nk_total = k_ref.shape[0] // block_k
     nk = _causal_nk(i, block_q, block_k, nk_total) if causal else nk_total
 
@@ -196,7 +206,7 @@ def _dq_kernel(
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_row)
+        ds = p * (dp + adj_row)
         return dq + jax.lax.dot_general(
             ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -207,7 +217,7 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, dlse_ref, dk_ref, dv_ref,
     *, scale, causal, block_q, kv_len,
 ):
     block_k, d = k_ref.shape
@@ -222,7 +232,10 @@ def _dkv_kernel(
         qb = q_ref[pl.dslice(i * block_q, block_q), :]
         dob = do_ref[pl.dslice(i * block_q, block_q), :]
         lse_row = lse_ref[pl.dslice(i * block_q, block_q), 0:1]
-        delta_row = delta_ref[pl.dslice(i * block_q, block_q), 0:1]
+        adj_row = (
+            dlse_ref[pl.dslice(i * block_q, block_q), 0:1]
+            - delta_ref[pl.dslice(i * block_q, block_q), 0:1]
+        )
         s = _scores(qb, kb, scale)
         mask = _block_mask(i, j, block_q, block_k, kv_len, causal)
         p = jnp.where(mask, jnp.exp(s - lse_row), 0.0)
@@ -234,7 +247,7 @@ def _dkv_kernel(
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_row)
+        ds = p * (dp + adj_row)
         dk = dk + jax.lax.dot_general(
             ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -248,7 +261,8 @@ def _dkv_kernel(
 
 
 def _flash_bwd(
-    q3, k3, v3, out3, lse, do3, scale, causal, block_q, block_k, kv_len, interpret
+    q3, k3, v3, out3, lse, do3, dlse, scale, causal, block_q, block_k, kv_len,
+    interpret,
 ):
     bh, sq, d = q3.shape
     skv = k3.shape[1]
@@ -269,11 +283,12 @@ def _flash_bwd(
             pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_q, 8), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, block_q, 8), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 8), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(q3, k3, v3, do3, lse, delta, dlse)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -287,6 +302,7 @@ def _flash_bwd(
             pl.BlockSpec((None, sq, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((None, sq, 8), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((None, sq, 8), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((None, sq, 8), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
@@ -297,7 +313,7 @@ def _flash_bwd(
             jax.ShapeDtypeStruct((bh, skv, d), v3.dtype),
         ],
         interpret=interpret,
-    )(k3, v3, q3, do3, lse, delta)
+    )(k3, v3, q3, do3, lse, delta, dlse)
     return dq, dk, dv
 
 
@@ -306,21 +322,26 @@ def _flash_bwd(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_core(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret):
-    out, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret)
-    return out
+    """Returns ``(out3, lse3)``; both are differentiable outputs (the lse
+    cotangent folds into the backward kernels as an extra ``p·dlse`` term),
+    which is what lets ring attention differentiate through its
+    online-softmax combination of per-shard partials."""
+    return _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret)
 
 
 def _flash_core_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret):
     out, lse = _flash_fwd(
         q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret
     )
-    return out, (q3, k3, v3, out, lse)
+    return (out, lse), (q3, k3, v3, out, lse)
 
 
-def _flash_core_bwd(scale, causal, block_q, block_k, kv_len, interpret, res, do3):
+def _flash_core_bwd(scale, causal, block_q, block_k, kv_len, interpret, res, cots):
     q3, k3, v3, out3, lse = res
+    do3, dlse = cots
     dq, dk, dv = _flash_bwd(
-        q3, k3, v3, out3, lse, do3, scale, causal, block_q, block_k, kv_len, interpret
+        q3, k3, v3, out3, lse, do3, dlse, scale, causal, block_q, block_k, kv_len,
+        interpret,
     )
     return dq, dk, dv
 
@@ -338,7 +359,8 @@ def flash_attention(
     block_q: int = 128,
     block_k: int | None = None,
     interpret: bool = False,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+):
     """Pallas flash attention over (B, H, S, D), differentiable.
 
     Pads S to block multiples and D up to a lane multiple (128); the true
@@ -371,11 +393,14 @@ def flash_attention(
         x3 = x.reshape(b * h, x.shape[2], d)
         return jnp.pad(x3, ((0, 0), (0, s_p - x.shape[2]), (0, d_p - d)))
 
-    out3 = _flash_core(
+    out3, lse3 = _flash_core(
         pad3(q, sq_p), pad3(k, skv_p), pad3(v, skv_p),
         scale, causal, block_q, block_k, skv, interpret,
     )
-    return out3[:, :sq, :d].reshape(b, h, sq, d)
+    out = out3[:, :sq, :d].reshape(b, h, sq, d)
+    if return_lse:
+        return out, lse3[:, :sq, 0].reshape(b, h, sq)
+    return out
 
 
 def attention(
@@ -386,7 +411,8 @@ def attention(
     causal: bool = False,
     scale: float | None = None,
     impl: str = "auto",
-) -> jnp.ndarray:
+    return_lse: bool = False,
+):
     """Dispatch: Pallas kernel on TPU for non-trivial sequences, jnp
     reference elsewhere (CPU CI, tiny sequences where one fused XLA softmax
     beats a kernel launch per (batch, head))."""
@@ -399,7 +425,11 @@ def attention(
             "pallas" if on_tpu and kernel_ok and q.shape[2] >= 256 else "reference"
         )
     if impl == "pallas":
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, return_lse=return_lse
+        )
     if impl == "reference":
-        return mha_reference(q, k, v, causal=causal, scale=scale)
+        return mha_reference(
+            q, k, v, causal=causal, scale=scale, return_lse=return_lse
+        )
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -17,7 +17,10 @@ topology; variants are configurations of it:
 - multi-host → same program after ``jax.distributed.initialize`` (the
   ``init_process_group`` analogue; DCN rendezvous instead of a TCP store),
 - tensor parallelism → a nontrivial ``model`` axis (capability the
-  reference lacks).
+  reference lacks),
+- sequence/context parallelism → ring attention (``ppermute`` K/V
+  rotation) or Ulysses all-to-all over a mesh axis, for sequences that
+  outgrow one chip (``ring.py``; capability the reference lacks).
 """
 
 from .mesh import make_mesh, mesh_shape_for_backend
@@ -37,6 +40,12 @@ from .tp import (
     state_shardings,
 )
 from .dist import init_distributed, is_main_process, process_count, process_index
+from .ring import (
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "make_mesh",
@@ -56,4 +65,8 @@ __all__ = [
     "is_main_process",
     "process_count",
     "process_index",
+    "ring_attention",
+    "ulysses_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
 ]

@@ -104,6 +104,41 @@ def test_attention_dispatcher():
         attention(q, k, v, impl="nope")
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_lse_and_its_cotangent(causal):
+    """return_lse parity AND the dlse backward path through the Pallas
+    kernels: a loss that uses BOTH outputs must match reference autodiff —
+    this is the path ring attention differentiates through."""
+    q, k, v, do = _rand_qkv(21 + causal, 200, 200, 64)
+
+    def loss(attn):
+        def f(q, k, v):
+            o, lse = attn(q, k, v)
+            return (o * do).sum() + (jnp.sin(lse)).sum()  # nonzero dlse
+        return f
+
+    flash = loss(
+        lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, interpret=True, return_lse=True
+        )
+    )
+    ref = loss(
+        lambda q, k, v: mha_reference(q, k, v, causal=causal, return_lse=True)
+    )
+    with jax.default_matmul_precision("highest"):
+        of, lf = flash_attention(
+            q, k, v, causal=causal, interpret=True, return_lse=True
+        )
+        orr, lr = mha_reference(q, k, v, causal=causal, return_lse=True)
+        gf = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    assert lf.shape == (2, 3, 200) and lf.dtype == jnp.float32
+    assert float(jnp.max(jnp.abs(lf - lr))) < 1e-5
+    assert float(jnp.max(jnp.abs(of - orr))) < 2e-5
+    for a, b, name in zip(gf, gr, "qkv"):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-3, f"d{name}"
+
+
 def test_flash_jit_and_grad_compile():
     """The custom_vjp plumbing stays jittable (static meta args hash)."""
     q, k, v, do = _rand_qkv(9, 128, 128, 64)

@@ -1,0 +1,97 @@
+"""Sequence/context parallelism vs full attention on the 8-device CPU mesh.
+
+Ring attention and Ulysses all-to-all must be *exact*: the sequence axis is
+sharded over mesh devices, yet outputs and all three gradients match a
+single-device full-attention reference to fp32 tolerance — causal and not.
+The reference repo has nothing to compare against here (no sequence axis
+anywhere, SURVEY.md §2.2); the contract is mathematical equivalence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu.ops import mha_reference
+from distributed_training_comparison_tpu.parallel import (
+    make_mesh,
+    make_ring_attention,
+    make_ulysses_attention,
+)
+
+B, H, S, D = 4, 8, 256, 32
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    kq, kk, kv, kdo = jax.random.split(jax.random.key(0), 4)
+    return (
+        jax.random.normal(kq, (B, H, S, D), jnp.float32),
+        jax.random.normal(kk, (B, H, S, D), jnp.float32),
+        jax.random.normal(kv, (B, H, S, D), jnp.float32),
+        jax.random.normal(kdo, (B, H, S, D), jnp.float32),
+    )
+
+
+@pytest.fixture(scope="module", params=[(2, 4), (1, 8)], ids=["mesh2x4", "mesh1x8"])
+def mesh(request):
+    data, model = request.param
+    return make_mesh(8, model)
+
+
+@pytest.mark.parametrize("maker", [make_ring_attention, make_ulysses_attention],
+                         ids=["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(mesh, qkv, maker, causal):
+    q, k, v, _ = qkv
+    with jax.default_matmul_precision("highest"):
+        full = mha_reference(q, k, v, causal=causal)
+        out = maker(mesh, causal=causal)(q, k, v)
+    assert float(jnp.max(jnp.abs(out - full))) < 1e-5
+
+
+@pytest.mark.parametrize("maker", [make_ring_attention, make_ulysses_attention],
+                         ids=["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_full_attention(mesh, qkv, maker, causal):
+    q, k, v, do = qkv
+    sp = maker(mesh, causal=causal)
+    with jax.default_matmul_precision("highest"):
+        g_sp = jax.grad(
+            lambda q, k, v: (sp(q, k, v) * do).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+        g_full = jax.grad(
+            lambda q, k, v: (mha_reference(q, k, v, causal=causal) * do).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    for a, b, name in zip(g_sp, g_full, "qkv"):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-4, f"d{name}"
+
+
+def test_ring_preserves_dtype_and_sharding(qkv):
+    mesh = make_mesh(8, 4)
+    q, k, v, _ = qkv
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = make_ring_attention(mesh)(qb, kb, vb)
+    assert out.dtype == jnp.bfloat16 and out.shape == (B, H, S, D)
+
+
+def test_ulysses_rejects_indivisible_heads(qkv):
+    mesh = make_mesh(8, 8)  # seq axis 8; H=8 ok — build a 3-head input
+    q = jnp.zeros((2, 3, S, D), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        make_ulysses_attention(mesh)(q, q, q)
+
+
+def test_ring_jits_under_jit(qkv):
+    """The shard_map'd ring composes with an outer jit (how a train step
+    would embed it)."""
+    mesh = make_mesh(8, 4)
+    q, k, v, _ = qkv
+    ring = make_ring_attention(mesh, causal=True)
+    with jax.default_matmul_precision("highest"):
+        out_jit = jax.jit(ring)(q, k, v)
+        out_eager = ring(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_jit), np.asarray(out_eager), atol=1e-6
+    )
