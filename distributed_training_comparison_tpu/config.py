@@ -114,8 +114,26 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--model-parallel",
         type=int,
         default=1,
-        help="Model-parallel mesh axis size (tensor parallelism); "
-        "data-parallel size = num_devices / model_parallel",
+        help="Model-parallel mesh axis size; data-parallel size = "
+        "num_devices / model_parallel. --parallel-style picks what the "
+        "axis does (tensor vs pipeline parallelism)",
+    )
+    parser.add_argument(
+        "--parallel-style",
+        type=str,
+        default="tensor",
+        choices=["tensor", "pipeline"],
+        help="How the model axis is used when --model-parallel > 1: "
+        "'tensor' = Megatron-style channel sharding (ResNet stages 3-4 + "
+        "head); 'pipeline' = GPipe microbatch pipeline over the stacked "
+        "transformer trunk (vit_* models only)",
+    )
+    parser.add_argument(
+        "--pipeline-microbatches",
+        type=int,
+        default=0,
+        help="Microbatches per step for --parallel-style pipeline "
+        "(0 = auto: 4x the stage count; bubble fraction (P-1)/(M+P-1))",
     )
     parser.add_argument(
         "--precision",

@@ -6,25 +6,28 @@ attention").  This transformer family gives the framework a sequence axis,
 which is what makes the long-context machinery real: attention runs
 through ``ops.attention`` (the Pallas flash kernel on TPU), and the
 sequence dimension is what ring attention (``parallel/ring.py``) and
-pipeline parallelism shard.
+pipeline parallelism (``parallel/pipeline.py``) shard.
 
 TPU-native choices:
 
 - **Scanned trunk**: the ``depth`` identical pre-LN blocks are one
   ``nn.scan`` over stacked parameters ``(depth, ...)`` — one block trace
-  instead of ``depth`` unrolled copies (faster compiles, and the stacked
+  instead of ``depth`` unrolled copies (faster compiles), and the stacked
   leading axis is exactly what stage-sharded pipeline parallelism
-  partitions).
+  partitions.
+- **Separable forward**: ``embed`` / ``trunk`` / ``head`` are standalone
+  methods (``__call__`` chains them), so the pipeline-parallel path can
+  run the identical embed/head computations on the identical parameters
+  and replace only the trunk with its staged schedule.
 - **bf16 policy** like the ResNet zoo: activations/matmuls in ``dtype``,
-  parameters fp32, LayerNorm statistics in fp32 by default (``norm_dtype``
-  mirrors the ResNet ``norm_dtype`` contract: ``None`` → reduce in the
-  compute dtype), fp32 logits.
+  parameters fp32, LayerNorm statistics under the shared ``norm_dtype``
+  contract (``models/norms.py``), fp32 logits.
 - **Global-average-pool head** (no class token): keeps the sequence
   homogeneous — every token flows through the same scanned/sharded path.
 
-Shapes: CIFAR 32×32 with ``patch=4`` → 64 tokens.  ``stem`` is accepted
-for ``get_model`` interface compatibility and ignored (the patch embed is
-the stem).
+Shapes: ``image_size=32`` with ``patch=4`` → 64 tokens.  ``stem`` is
+accepted for ``get_model`` interface compatibility and ignored (the patch
+embed is the stem).
 """
 
 from __future__ import annotations
@@ -34,7 +37,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-Dense = nn.Dense  # kernels xavier-init below where it matters
+from .norms import norm_policy
 
 
 class ViTBlock(nn.Module):
@@ -50,34 +53,26 @@ class ViTBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, _carry_in=None):
         from ..ops import attention
-        from .norms import norm_policy
 
         norm = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)
+        xavier = nn.initializers.xavier_uniform()
         b, s, dim = x.shape
         hd = dim // self.heads
 
         h = norm(name="ln_attn")(x).astype(self.dtype)
-        qkv = Dense(
-            3 * dim, dtype=self.dtype, name="qkv",
-            kernel_init=nn.initializers.xavier_uniform(),
-        )(h)
+        qkv = nn.Dense(3 * dim, dtype=self.dtype, kernel_init=xavier, name="qkv")(h)
         qkv = qkv.reshape(b, s, 3, self.heads, hd).transpose(2, 0, 3, 1, 4)
         o = attention(qkv[0], qkv[1], qkv[2], impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, dim)
-        x = x + Dense(
-            dim, dtype=self.dtype, name="proj",
-            kernel_init=nn.initializers.xavier_uniform(),
-        )(o)
+        x = x + nn.Dense(dim, dtype=self.dtype, kernel_init=xavier, name="proj")(o)
 
         h = norm(name="ln_mlp")(x).astype(self.dtype)
-        h = Dense(
-            self.mlp_ratio * dim, dtype=self.dtype, name="mlp_up",
-            kernel_init=nn.initializers.xavier_uniform(),
+        h = nn.Dense(
+            self.mlp_ratio * dim, dtype=self.dtype, kernel_init=xavier, name="mlp_up"
         )(h)
         h = nn.gelu(h)
-        x = x + Dense(
-            dim, dtype=self.dtype, name="mlp_down",
-            kernel_init=nn.initializers.xavier_uniform(),
+        x = x + nn.Dense(
+            dim, dtype=self.dtype, kernel_init=xavier, name="mlp_down"
         )(h)
         return x, None
 
@@ -91,38 +86,32 @@ class ViT(nn.Module):
     patch: int = 4
     mlp_ratio: int = 4
     num_classes: int = 100
+    image_size: int = 32
     dtype: Any = jnp.float32
     norm_dtype: Any = jnp.float32
     attn_impl: str = "auto"
     remat: bool = False
     stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
 
-    @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
-        x = x.astype(self.dtype)
-        x = nn.Conv(
+    def setup(self):
+        xavier = nn.initializers.xavier_uniform()
+        self.patch_embed = nn.Conv(
             self.dim,
             kernel_size=(self.patch, self.patch),
             strides=self.patch,
             padding=0,
             dtype=self.dtype,
-            kernel_init=nn.initializers.xavier_uniform(),
-            name="patch_embed",
-        )(x)
-        b, h, w, _ = x.shape
-        x = x.reshape(b, h * w, self.dim)
-        pos = self.param(
-            "pos_emb",
-            nn.initializers.normal(stddev=0.02),
-            (1, h * w, self.dim),
-            jnp.float32,
+            kernel_init=xavier,
         )
-        x = x + pos.astype(self.dtype)
-
+        tokens = (self.image_size // self.patch) ** 2
+        self.pos_emb = self.param(
+            "pos_emb", nn.initializers.normal(stddev=0.02),
+            (1, tokens, self.dim), jnp.float32,
+        )
         block = ViTBlock
         if self.remat:
             block = nn.remat(block, prevent_cse=False)
-        x, _ = nn.scan(
+        self.blocks = nn.scan(
             block,
             variable_axes={"params": 0},
             split_rngs={"params": True},
@@ -135,22 +124,34 @@ class ViT(nn.Module):
             dtype=self.dtype,
             norm_dtype=self.norm_dtype,
             attn_impl=self.attn_impl,
-            name="blocks",
-        )(x, None)
+        )
+        self.ln_head = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)()
+        self.head = nn.Dense(
+            self.num_classes, dtype=self.dtype, kernel_init=xavier
+        )
 
-        from .norms import norm_policy
+    def embed(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Images (B, H, W, 3) → tokens (B, S, dim) with position added."""
+        b, h, w, _ = x.shape
+        if h != self.image_size or w != self.image_size:
+            raise ValueError(
+                f"ViT(image_size={self.image_size}) got {h}x{w} input"
+            )
+        x = self.patch_embed(x.astype(self.dtype))
+        x = x.reshape(b, -1, self.dim)
+        return x + self.pos_emb.astype(self.dtype)
 
-        x = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)(
-            name="ln_head"
-        )(x).astype(self.dtype)
+    def trunk(self, x: jnp.ndarray) -> jnp.ndarray:
+        x, _ = self.blocks(x, None)
+        return x
+
+    def head_out(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = self.ln_head(x).astype(self.dtype)
         x = jnp.mean(x, axis=1)
-        x = Dense(
-            self.num_classes,
-            dtype=self.dtype,
-            kernel_init=nn.initializers.xavier_uniform(),
-            name="head",
-        )(x)
-        return x.astype(jnp.float32)
+        return self.head(x).astype(jnp.float32)
+
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        return self.head_out(self.trunk(self.embed(x)))
 
 
 def ViTTiny(**kw) -> ViT:

@@ -20,7 +20,10 @@ topology; variants are configurations of it:
   reference lacks),
 - sequence/context parallelism → ring attention (``ppermute`` K/V
   rotation) or Ulysses all-to-all over a mesh axis, for sequences that
-  outgrow one chip (``ring.py``; capability the reference lacks).
+  outgrow one chip (``ring.py``; capability the reference lacks),
+- pipeline parallelism → GPipe microbatch schedule over the stacked
+  transformer trunk, stages sharded on the model axis (``pipeline.py``;
+  capability the reference lacks).
 """
 
 from .mesh import make_mesh, mesh_shape_for_backend
@@ -46,6 +49,13 @@ from .ring import (
     ring_attention,
     ulysses_attention,
 )
+from .pipeline import (
+    make_pipeline_trunk,
+    make_pipelined_apply_fn,
+    pipeline_stages,
+    pipelined_vit_apply,
+    pp_state_shardings,
+)
 
 __all__ = [
     "make_mesh",
@@ -69,4 +79,9 @@ __all__ = [
     "ulysses_attention",
     "make_ring_attention",
     "make_ulysses_attention",
+    "pipeline_stages",
+    "make_pipeline_trunk",
+    "pipelined_vit_apply",
+    "make_pipelined_apply_fn",
+    "pp_state_shardings",
 ]

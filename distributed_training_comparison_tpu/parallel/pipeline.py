@@ -1,0 +1,216 @@
+"""GPipe-style pipeline parallelism over stacked homogeneous layers.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2 — absent).  This
+module completes the framework's parallelism axes (data / tensor /
+sequence / pipeline) for the transformer family, whose scanned trunk
+already stores its ``depth`` identical blocks as one stacked pytree
+``(depth, ...)`` — the natural thing to shard across pipeline stages.
+
+Design (TPU-first):
+
+- The ``"model"`` mesh axis doubles as the **pipe** axis (one mesh, the
+  second axis's meaning is chosen by the parallelism style, exactly like
+  TP and ring attention).  Each device holds ``depth/P`` consecutive
+  layers — a contiguous slice of the stacked parameters, placed by an
+  ordinary ``PartitionSpec`` on the leading axis.
+- The schedule is plain GPipe: the global batch splits into M
+  microbatches; at each of ``M + P - 1`` ticks every stage applies its
+  layer slice to its current microbatch and hands the activation to the
+  next stage over ``lax.ppermute`` (one ICI neighbor hop).  The loop is
+  unrolled at trace time (M and P are static) — no dynamic control flow
+  for XLA to choke on.
+- **Backward is free**: the whole schedule is differentiable jnp code
+  inside ``shard_map``, so ``jax.grad`` produces the reverse pipeline
+  (ppermute transposes to the opposite rotation) without a hand-written
+  backward schedule.
+- Bubble fraction is the textbook ``(P-1)/(M+P-1)``; raise M to amortize.
+
+``pipelined_vit_apply`` runs a zoo ViT with its trunk staged this way,
+reusing the model's own ``embed``/``head_out`` methods and parameters —
+the pipelined forward is the *same function* as ``model.apply`` (tested to
+fp32 tolerance, gradients included), just scheduled across devices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS
+
+
+def pipeline_stages(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    local_params: Any,
+    microbatches: jnp.ndarray,
+    *,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Run the GPipe schedule; call inside ``shard_map``.
+
+    ``local_params``: this stage's layer slice (leaves ``(L/P, ...)``).
+    ``microbatches``: ``(M, mb, ...)`` inputs, replicated across the pipe
+    axis.  Returns ``(M, mb, ...)`` outputs, replicated (broadcast from
+    the last stage).
+    """
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    is_first = idx == 0
+    is_last = idx == p_size - 1
+    perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+
+    state = jnp.zeros_like(microbatches[0])
+    outs = jnp.zeros_like(microbatches)
+    for t in range(m + p_size - 1):
+        feed = microbatches[min(t, m - 1)]  # garbage past M; never collected
+        y = stage_fn(local_params, jnp.where(is_first, feed, state))
+        j = t - (p_size - 1)  # microbatch leaving the last stage this tick
+        if 0 <= j < m:
+            outs = outs.at[j].set(jnp.where(is_last, y, outs[j]))
+        if t + 1 < m + p_size - 1:
+            state = jax.lax.ppermute(y, axis_name, perm)
+    # broadcast the last stage's outputs to every stage (replicated out)
+    return jax.lax.psum(
+        jnp.where(is_last, outs, jnp.zeros_like(outs)), axis_name
+    )
+
+
+def make_pipeline_trunk(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    *,
+    num_microbatches: int,
+    pipe_axis: str = MODEL_AXIS,
+    data_axis: str | None = DATA_AXIS,
+):
+    """Global-array wrapper: ``(stacked_params, tokens) -> tokens`` with the
+    layer stack sharded over ``pipe_axis`` and the batch over ``data_axis``."""
+
+    def run(stacked_params, tokens: jnp.ndarray) -> jnp.ndarray:
+        b = tokens.shape[0]
+        m = num_microbatches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by microbatches {m}")
+        mb = tokens.reshape(m, b // m, *tokens.shape[1:])
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(pipe_axis), stacked_params
+        )
+        mb_spec = P(None, data_axis, *([None] * (mb.ndim - 2)))
+        staged = shard_map(
+            partial(pipeline_stages, stage_fn, axis_name=pipe_axis),
+            mesh=mesh,
+            in_specs=(param_specs, mb_spec),
+            out_specs=mb_spec,
+            check_vma=False,
+        )
+        return staged(stacked_params, mb).reshape(b, *tokens.shape[1:])
+
+    return run
+
+
+def pp_state_shardings(
+    mesh: Mesh, state, *, pipe_axis: str = MODEL_AXIS, blocks_key: str = "blocks"
+):
+    """``TrainState`` shardings for the pipeline layout: the stacked trunk
+    (leading ``depth`` axis) is sharded across pipeline stages, everything
+    else — embed/head params, (empty) batch stats — is replicated; the
+    optimizer's momentum mirrors the params via the shared suffix-matching
+    builder (``tp.build_state_shardings``)."""
+    from .tp import build_state_shardings
+
+    repl = P()
+
+    def pspec(mod, sub):
+        if mod == blocks_key:
+            return jax.tree_util.tree_map(lambda _: P(pipe_axis), sub)
+        return jax.tree_util.tree_map(lambda _: repl, sub)
+
+    pspecs = {mod: pspec(mod, sub) for mod, sub in state.params.items()}
+    bspecs = jax.tree_util.tree_map(lambda _: repl, state.batch_stats)
+    return build_state_shardings(mesh, state, pspecs, bspecs)
+
+
+def make_pipelined_apply_fn(model, mesh: Mesh, *, num_microbatches: int):
+    """An ``apply_fn`` drop-in for ``TrainState`` that runs the pipelined
+    forward with the train step's calling conventions (``train=``,
+    ``mutable=`` — the transformer family has no mutable collections)."""
+
+    def apply_fn(variables, x, train=False, mutable=()):
+        logits = pipelined_vit_apply(
+            model, variables, x, mesh, num_microbatches=num_microbatches
+        )
+        return (logits, {}) if mutable else logits
+
+    return apply_fn
+
+
+def vit_stage_fn(model) -> Callable[[Any, jnp.ndarray], jnp.ndarray]:
+    """A pipeline stage for a zoo ViT: scan this stage's block slice.
+
+    The stage applies the *same* ``ViTBlock`` module the model's scanned
+    trunk uses, on slices of the model's own stacked parameters — so the
+    staged trunk can never diverge from ``model.trunk``.
+    """
+    from ..models.vit import ViTBlock
+
+    block_cls = ViTBlock
+    if model.remat:  # honor --remat: param structure is unchanged
+        block_cls = nn.remat(ViTBlock, prevent_cse=False)
+    block = block_cls(
+        dim=model.dim,
+        heads=model.heads,
+        mlp_ratio=model.mlp_ratio,
+        dtype=model.dtype,
+        norm_dtype=model.norm_dtype,
+        attn_impl=model.attn_impl,
+    )
+
+    def stage(local_params, x):
+        def body(c, layer_params):
+            y, _ = block.apply({"params": layer_params}, c, None)
+            return y, None
+
+        x, _ = jax.lax.scan(body, x, local_params)
+        return x
+
+    return stage
+
+
+def pipelined_vit_apply(
+    model,
+    variables,
+    images: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    pipe_axis: str = MODEL_AXIS,
+    data_axis: str | None = DATA_AXIS,
+) -> jnp.ndarray:
+    """Forward a zoo ViT with its trunk pipelined over ``pipe_axis``.
+
+    Embed and head run as ordinary (data-parallel) computations via the
+    model's own methods on the same ``variables``; only the trunk is
+    staged.  Semantically identical to ``model.apply(variables, images)``.
+    """
+    p_size = mesh.shape[pipe_axis]
+    if model.depth % p_size:
+        raise ValueError(
+            f"depth {model.depth} not divisible by pipeline stages {p_size}"
+        )
+    tokens = model.apply(variables, images, method="embed")
+    trunk = make_pipeline_trunk(
+        mesh,
+        vit_stage_fn(model),
+        num_microbatches=num_microbatches,
+        pipe_axis=pipe_axis,
+        data_axis=data_axis,
+    )
+    y = trunk(variables["params"]["blocks"], tokens)
+    return model.apply(variables, y, method="head_out")

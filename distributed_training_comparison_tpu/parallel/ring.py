@@ -37,10 +37,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import attention
+from ..ops.attention import _NEG_INF as _NEG_BIG, attention
 from .mesh import DATA_AXIS, MODEL_AXIS
-
-_NEG_BIG = -1e30  # finite -inf: keeps exp()s zero without inf-inf NaNs
 
 
 def _combine(out_a, lse_a, out_b, lse_b):

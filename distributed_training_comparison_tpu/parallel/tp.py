@@ -131,13 +131,20 @@ def state_shardings(mesh: Mesh, state):
 
     Works for any mesh: with ``model`` axis size 1 all specs are effectively
     replicated (the parity configs); with ``model`` > 1 stage-3/4 and the
-    head are genuinely partitioned.  Optimizer-state leaves (the momentum
-    ``trace`` mirrors params) are matched by key-path suffix against the
-    param tree so the layout needs no knowledge of optax's state structure.
+    head are genuinely partitioned.
     """
     pspecs = param_partition_specs(state.params)
     bspecs = batch_stats_partition_specs(state.params, state.batch_stats)
+    return build_state_shardings(mesh, state, pspecs, bspecs)
 
+
+def build_state_shardings(mesh: Mesh, state, pspecs, bspecs):
+    """Map param/batch-stat partition specs over a whole ``TrainState``.
+
+    Optimizer-state leaves (the momentum ``trace`` mirrors params) are
+    matched by key-path suffix against the param tree so layouts (TP,
+    pipeline, ...) need no knowledge of optax's state structure.
+    """
     suffix_map: dict[tuple[str, ...], P] = {}
     for kp, spec in jax.tree_util.tree_flatten_with_path(pspecs)[0]:
         suffix_map[_key_names(kp)] = spec

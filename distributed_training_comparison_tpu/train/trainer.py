@@ -160,9 +160,41 @@ class Trainer:
         init_key, self.data_key = jax.random.split(self.root_key)
         with jax.default_device(jax.local_devices()[0]):
             state = create_train_state(self.model, init_key, self.tx)
-        # TP layout over the "model" axis (degenerates to replicated when
-        # model_parallel == 1, so one placement path serves every variant)
-        self.state_sharding = state_shardings(self.mesh, state)
+        # The "model" axis's meaning is the --parallel-style: tensor
+        # parallelism (Megatron param sharding, the default) or a GPipe
+        # pipeline over the stacked transformer trunk.  Both degenerate to
+        # fully-replicated at model_parallel == 1, so one placement path
+        # serves every variant.
+        style = getattr(hparams, "parallel_style", "tensor")
+        mp_size = self.mesh.shape["model"]
+        if style == "pipeline" and mp_size > 1:
+            from ..models.vit import ViT
+            from ..parallel.pipeline import (
+                make_pipelined_apply_fn,
+                pp_state_shardings,
+            )
+
+            if not isinstance(self.model, ViT):
+                raise ValueError(
+                    "--parallel-style pipeline needs a stacked transformer "
+                    f"trunk (vit_* models); got --model {hparams.model}"
+                )
+            micro = getattr(hparams, "pipeline_microbatches", 0) or 4 * mp_size
+            per_micro = hparams.batch_size // self.grad_accum
+            if per_micro % (micro * n_data):
+                raise ValueError(
+                    f"per-update batch {per_micro} not divisible by "
+                    f"pipeline microbatches ({micro}) x data-parallel size "
+                    f"({n_data}); adjust --batch-size/--pipeline-microbatches"
+                )
+            state = state.replace(
+                apply_fn=make_pipelined_apply_fn(
+                    self.model, self.mesh, num_microbatches=micro
+                )
+            )
+            self.state_sharding = pp_state_shardings(self.mesh, state)
+        else:
+            self.state_sharding = state_shardings(self.mesh, state)
         self.state = place_tree(state, self.state_sharding)
 
         # --- compiled programs

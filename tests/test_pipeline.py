@@ -1,0 +1,174 @@
+"""Pipeline parallelism: the GPipe schedule computes exactly the scanned
+trunk (forward AND gradients), stage params are genuinely partitioned, and
+the Trainer's --parallel-style pipeline path trains like the unsharded
+baseline.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2); the contract
+is equivalence with the single-device scanned forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.models import ViT
+from distributed_training_comparison_tpu.parallel import (
+    make_mesh,
+    pipelined_vit_apply,
+    pp_state_shardings,
+)
+from distributed_training_comparison_tpu.train import Trainer
+
+
+MODEL_KW = dict(depth=8, dim=32, heads=2, patch=8)
+
+
+@pytest.fixture(scope="module")
+def vit_and_vars():
+    model = ViT(**MODEL_KW)
+    x = jax.random.normal(jax.random.key(1), (8, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x, train=False)
+    return model, variables, x
+
+
+@pytest.mark.parametrize("microbatches", [1, 2, 4])
+def test_pipelined_forward_matches_direct(vit_and_vars, microbatches):
+    model, variables, x = vit_and_vars
+    mesh = make_mesh(8, 4)
+    with jax.default_matmul_precision("highest"):
+        direct = model.apply(variables, x, train=False)
+        piped = pipelined_vit_apply(
+            model, variables, x, mesh, num_microbatches=microbatches
+        )
+    assert float(jnp.max(jnp.abs(direct - piped))) < 1e-5
+
+
+def test_pipelined_gradients_match_direct(vit_and_vars):
+    model, variables, x = vit_and_vars
+    mesh = make_mesh(8, 4)
+    with jax.default_matmul_precision("highest"):
+        g_direct = jax.grad(
+            lambda v: (model.apply(v, x, train=False) ** 2).mean()
+        )(variables)
+        g_piped = jax.grad(
+            lambda v: (
+                pipelined_vit_apply(model, v, x, mesh, num_microbatches=4) ** 2
+            ).mean()
+        )(variables)
+    worst = max(
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(
+                lambda a, b: float(jnp.max(jnp.abs(a - b))), g_direct, g_piped
+            )
+        )
+    )
+    assert worst < 1e-5
+
+
+def test_pipelined_remat_matches_direct(vit_and_vars):
+    """--remat must stay in force under the staged trunk (same params,
+    same math, rematerialized backward)."""
+    model, variables, x = vit_and_vars
+    remat_model = ViT(remat=True, **MODEL_KW)
+    mesh = make_mesh(8, 4)
+    with jax.default_matmul_precision("highest"):
+        direct = model.apply(variables, x, train=False)
+        piped = pipelined_vit_apply(
+            remat_model, variables, x, mesh, num_microbatches=2
+        )
+        g = jax.grad(
+            lambda v: (
+                pipelined_vit_apply(remat_model, v, x, mesh, num_microbatches=2)
+                ** 2
+            ).mean()
+        )(variables)
+    assert float(jnp.max(jnp.abs(direct - piped))) < 1e-5
+    assert all(
+        bool(jnp.all(jnp.isfinite(leaf))) for leaf in jax.tree_util.tree_leaves(g)
+    )
+
+
+def test_depth_must_divide_stages(vit_and_vars):
+    _, _, x = vit_and_vars
+    bad = ViT(depth=6, dim=32, heads=2, patch=8)
+    bv = bad.init(jax.random.key(0), x, train=False)
+    with pytest.raises(ValueError, match="not divisible"):
+        pipelined_vit_apply(bad, bv, x, make_mesh(8, 4), num_microbatches=2)
+
+
+def test_pp_state_shardings_partition_the_trunk(vit_and_vars):
+    from distributed_training_comparison_tpu.train import configure_optimizers, create_train_state
+    from distributed_training_comparison_tpu.parallel import place_tree
+
+    class HP:
+        lr = 0.1
+        weight_decay = 1e-4
+        lr_decay_step_size = 25
+        lr_decay_gamma = 0.1
+
+    model, _, _ = vit_and_vars
+    mesh = make_mesh(8, 4)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=10)
+    state = create_train_state(model, jax.random.key(0), tx)
+    placed = place_tree(state, pp_state_shardings(mesh, state))
+    qkv = placed.params["blocks"]["qkv"]["kernel"]
+    assert not qkv.sharding.is_fully_replicated
+    # each of the 4 stages holds 2 of the 8 stacked layers
+    assert {s.data.shape[0] for s in qkv.addressable_shards} == {2}
+    # embed/head replicated
+    assert placed.params["patch_embed"]["kernel"].sharding.is_fully_replicated
+    # momentum mirrors the param layout (suffix matching)
+    trace_leaf = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x, placed.opt_state)
+    )
+    assert any(not leaf.sharding.is_fully_replicated for leaf in trace_leaf)
+
+
+def _fit_losses(tmp_path, extra, tag):
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data",
+            "--limit-examples", "256",
+            "--batch-size", "64",
+            "--epoch", "2",
+            "--lr", "0.01",
+            "--ckpt-path", str(tmp_path / tag),
+            *extra,
+        ],
+    )
+    t = Trainer(hp, model=ViT(**MODEL_KW))
+    losses, _ = t._train_epoch_device(0)
+    out = np.asarray(losses)
+    t.close()
+    return out
+
+
+def test_trainer_pipeline_style_matches_baseline(tmp_path):
+    """One epoch under --parallel-style pipeline reproduces the unsharded
+    loss trajectory (same seed, same data) to fp32 tolerance."""
+    with jax.default_matmul_precision("highest"):
+        base = _fit_losses(tmp_path, [], "base")
+        piped = _fit_losses(
+            tmp_path,
+            ["--model-parallel", "4", "--parallel-style", "pipeline",
+             "--pipeline-microbatches", "2"],
+            "piped",
+        )
+    np.testing.assert_allclose(piped, base, atol=5e-4)
+
+
+def test_trainer_pipeline_rejects_resnet(tmp_path):
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "256",
+            "--batch-size", "64", "--model-parallel", "4",
+            "--parallel-style", "pipeline",
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    with pytest.raises(ValueError, match="pipeline"):
+        Trainer(hp)

@@ -74,6 +74,10 @@ def test_ring_preserves_dtype_and_sharding(qkv):
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
     out = make_ring_attention(mesh)(qb, kb, vb)
     assert out.dtype == jnp.bfloat16 and out.shape == (B, H, S, D)
+    # output stays sequence-sharded (the memory point of the exercise):
+    # each device holds S/4 rows of the sequence, B/2 of the batch
+    assert not out.sharding.is_fully_replicated
+    assert {s.data.shape for s in out.addressable_shards} == {(B // 2, H, S // 4, D)}
 
 
 def test_ulysses_rejects_indivisible_heads(qkv):
