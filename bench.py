@@ -10,11 +10,13 @@ native legs are this framework's TPU path: device-resident data, in-jit
 augmentation, one ``lax.scan`` dispatch per epoch.
 
 Configs (BASELINE.json "configs"): rn18/bs256 bf16 (headline), rn18/bs256
-fp32, rn50/bs512 bf16, and the ImageNet-scale leg rn50@224px bf16 through
-the 7×7/2 + maxpool stem (synthetic data — the dataset itself is
-unobtainable offline).  Each native leg reports MFU = achieved training
-FLOP/s ÷ chip peak, with model FLOPs counted analytically from the
-architecture (conv MACs × 2, backward ≈ 2× forward).
+fp32, rn50/bs512 bf16, the ImageNet-scale leg rn50@224px bf16 through the
+7×7/2 + maxpool stem (synthetic data — the dataset itself is unobtainable
+offline), and the transformer leg vit_tiny/bs256 bf16.  Each native leg
+reports MFU = achieved training FLOP/s ÷ chip peak, with model FLOPs
+counted analytically from the architecture (MACs × 2, backward ≈ 2×
+forward).  A long-sequence flash-attention leg reports the Pallas kernel's
+TF/s against the score-materializing jnp reference implementation.
 
 Output: ONE JSON line
 ``{"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
@@ -95,11 +97,26 @@ def forward_flops_per_image(
     return 2.0 * macs
 
 
+def vit_forward_flops_per_image(name: str, image_size: int = 32) -> float:
+    """Analytic forward FLOPs/image for the ViT zoo, read off the model
+    config: per block 12·d² MACs/token (qkv + proj + 4× MLP) plus the two
+    attention matmuls (2·S·d MACs/token), plus patch embed and head."""
+    m = models.get_model(name)
+    s = (image_size // m.patch) ** 2
+    d = m.dim
+    macs_per_token = m.depth * (12 * d * d + 2 * s * d)
+    macs = s * (macs_per_token + m.patch * m.patch * 3 * d)  # + patch embed
+    macs += d * m.num_classes
+    return 2.0 * macs
+
+
 def train_flops_per_image(
     name: str, image_size: int = 32, stem: str = "cifar"
 ) -> float:
     """fwd + bwd ≈ 3× fwd (standard estimate: grad-wrt-input + grad-wrt-
     weights each cost ≈ one forward)."""
+    if name.startswith("vit"):
+        return 3.0 * vit_forward_flops_per_image(name, image_size)
     return 3.0 * forward_flops_per_image(name, image_size=image_size, stem=stem)
 
 
@@ -162,6 +179,46 @@ def bench_native(
     return epochs * steps * batch_size / dt
 
 
+def bench_flash_attention(seq: int = 4096, ref_too: bool = True) -> dict:
+    """Pallas flash-attention kernel vs the jnp reference at long sequence
+    length (B=2, H=8, D=128, bf16).  Kernel calls chain inside one
+    ``lax.scan`` dispatch so tunnel/dispatch latency amortizes away (the
+    same one-dispatch trick the train path uses)."""
+    from distributed_training_comparison_tpu.ops import (
+        flash_attention,
+        mha_reference,
+    )
+
+    b, h, d = 2, 8, 128
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, seq, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, h, seq, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, h, seq, d), jnp.bfloat16)
+    flops = 4.0 * b * h * seq * seq * d
+
+    def timed(attn, m):
+        @jax.jit
+        def chain(q, k, v):
+            def body(c, _):
+                return attn(c, k, v), ()
+
+            o, _ = jax.lax.scan(body, q, None, length=m)
+            return o.astype(jnp.float32).sum()
+
+        float(chain(q, k, v))  # compile + warm
+        t0 = time.perf_counter()
+        float(chain(q, k, v))
+        return (time.perf_counter() - t0) / m
+
+    t_flash = timed(lambda q, k, v: flash_attention(q, k, v), 300)
+    out = {"seq": seq, "flash_tflops": round(flops / t_flash / 1e12, 1)}
+    if ref_too:
+        t_ref = timed(lambda q, k, v: mha_reference(q, k, v), 30)
+        out["reference_impl_tflops"] = round(flops / t_ref / 1e12, 1)
+        out["speedup"] = round(t_ref / t_flash, 1)
+    return out
+
+
 def bench_reference_style(mesh, images, labels, batch_size: int, steps: int) -> float:
     """Baseline leg: the reference's loop shape — python per-step loop,
     host-side shuffle + aug dispatch, H2D copy per batch, fp32, and a
@@ -214,6 +271,8 @@ def main() -> None:
             # inputs through the 7×7/2 + maxpool stem, 100-class head,
             # batch sized for one chip
             ("resnet50", "bf16", 128, 224, "imagenet", 4_096, 2),
+            # transformer family (beyond parity)
+            ("vit_tiny", "bf16", 256, 32, "cifar", 45_056, 3),
         ]
 
     per_config = {}
@@ -256,6 +315,11 @@ def main() -> None:
     ref_style = bench_reference_style(
         mesh, ref_data[0], ref_data[1], configs[0][2], ref_steps
     )
+    flash = (
+        bench_flash_attention()
+        if platform != "cpu" and n_chips == 1
+        else None
+    )
 
     print(
         json.dumps(
@@ -270,6 +334,7 @@ def main() -> None:
                     "chips": n_chips,
                     "chip_peak_bf16_tflops": round(peak / 1e12, 1) if peak else None,
                     "configs": per_config,
+                    "flash_attention": flash,
                     "reference_style_images_per_sec": round(ref_style, 1),
                     "baseline_definition": "same chip, reference loop shape: "
                     "per-step dispatch + H2D copy + per-step host sync, fp32",

@@ -102,12 +102,18 @@ class Trainer:
             if getattr(hparams, "bn_dtype", "fp32") == "compute"
             else jnp.float32
         )
-        self.model = model if model is not None else get_model(
-            hparams.model,
+        model_kw = dict(
             dtype=compute_dtype,
             norm_dtype=norm_dtype,
             stem=getattr(hparams, "stem", "cifar"),
             remat=getattr(hparams, "remat", False),
+        )
+        if hparams.model.startswith("vit"):
+            # the ViT sizes its position embedding in setup(); the ResNet
+            # family is resolution-agnostic and takes no such field
+            model_kw["image_size"] = getattr(hparams, "image_size", 32)
+        self.model = model if model is not None else get_model(
+            hparams.model, **model_kw
         )
 
         # --- data.  'device' mode: split is HBM-resident and replicated;
@@ -158,8 +164,11 @@ class Trainer:
         # --- optimizer + state
         self.tx, self.lr_schedule = configure_optimizers(hparams, self.steps_per_epoch)
         init_key, self.data_key = jax.random.split(self.root_key)
+        size = getattr(hparams, "image_size", 32) or 32
         with jax.default_device(jax.local_devices()[0]):
-            state = create_train_state(self.model, init_key, self.tx)
+            state = create_train_state(
+                self.model, init_key, self.tx, input_shape=(1, size, size, 3)
+            )
         # The "model" axis's meaning is the --parallel-style: tensor
         # parallelism (Megatron param sharding, the default) or a GPipe
         # pipeline over the stacked transformer trunk.  Both degenerate to

@@ -98,3 +98,26 @@ def test_trainer_end_to_end_vit(tmp_path):
 def test_config_accepts_vit_models():
     hp = load_config("tpu", argv=["--model", "vit_small", "--synthetic-data"])
     assert hp.model == "vit_small"
+
+
+def test_trainer_plumbs_image_size_to_vit(tmp_path):
+    """--image-size must reach the ViT's position embedding (it is sized in
+    setup(), unlike the resolution-agnostic ResNets)."""
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data",
+            "--image-size", "64",
+            "--limit-examples", "128",
+            "--batch-size", "32",
+            "--model", "vit_tiny",
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    t = Trainer(hp)
+    tokens = (64 // t.model.patch) ** 2
+    assert t.model.image_size == 64
+    assert t.state.params["pos_emb"].shape == (1, tokens, t.model.dim)
+    losses, _ = t._train_epoch_device(0)  # one epoch at 64px runs
+    assert np.all(np.isfinite(np.asarray(losses)))
+    t.close()
