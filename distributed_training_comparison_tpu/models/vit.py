@@ -60,8 +60,13 @@ class ViTBlock(nn.Module):
         hd = dim // self.heads
 
         h = norm(name="ln_attn")(x).astype(self.dtype)
+        # qkv packed HEAD-major ([h0: q|k|v, h1: q|k|v, ...]): under tensor
+        # parallelism the Dense output axis is sharded over the model axis,
+        # and head-major packing makes the shard boundaries fall on whole
+        # (q,k,v) head triples whenever heads % model_parallel == 0 — so
+        # attention stays head-local (parallel/tp.py _vit_trunk_specs)
         qkv = nn.Dense(3 * dim, dtype=self.dtype, kernel_init=xavier, name="qkv")(h)
-        qkv = qkv.reshape(b, s, 3, self.heads, hd).transpose(2, 0, 3, 1, 4)
+        qkv = qkv.reshape(b, s, self.heads, 3, hd).transpose(3, 0, 2, 1, 4)
         o = attention(qkv[0], qkv[1], qkv[2], impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, dim)
         x = x + nn.Dense(dim, dtype=self.dtype, kernel_init=xavier, name="proj")(o)

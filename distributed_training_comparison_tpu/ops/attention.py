@@ -415,7 +415,24 @@ def attention(
 ):
     """Dispatch: Pallas kernel on TPU for non-trivial sequences, jnp
     reference elsewhere (CPU CI, tiny sequences where one fused XLA softmax
-    beats a kernel launch per (batch, head))."""
+    beats a kernel launch per (batch, head)).
+
+    ``impl="ring[:axis]"`` / ``"ulysses[:axis]"`` dispatch to the
+    sequence-parallel implementations (``parallel/ring.py``) over the named
+    mesh axis (default ``"model"``) — for callers already inside
+    ``shard_map`` with the sequence sharded, e.g. a sequence-parallel model
+    trunk."""
+    kind, _, axis = impl.partition(":")
+    if kind in ("ring", "ulysses"):
+        if return_lse:
+            raise ValueError("return_lse is not supported through the "
+                             "sequence-parallel dispatch")
+        from ..parallel.ring import ring_attention, ulysses_attention
+
+        fn = ring_attention if kind == "ring" else ulysses_attention
+        return fn(
+            q, k, v, axis_name=axis or "model", causal=causal, scale=scale
+        )
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         # the kernel only supports square causal attention; offset-causal

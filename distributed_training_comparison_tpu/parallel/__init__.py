@@ -45,8 +45,10 @@ from .tp import (
 from .dist import init_distributed, is_main_process, process_count, process_index
 from .ring import (
     make_ring_attention,
+    make_sequence_apply_fn,
     make_ulysses_attention,
     ring_attention,
+    sequence_vit_apply,
     ulysses_attention,
 )
 from .pipeline import (
@@ -79,6 +81,8 @@ __all__ = [
     "ulysses_attention",
     "make_ring_attention",
     "make_ulysses_attention",
+    "sequence_vit_apply",
+    "make_sequence_apply_fn",
     "pipeline_stages",
     "make_pipeline_trunk",
     "pipelined_vit_apply",

@@ -188,3 +188,88 @@ def make_ulysses_attention(
     """Global-array convenience wrapper for ``ulysses_attention``."""
     fn = partial(ulysses_attention, causal=causal, scale=scale, impl=impl)
     return _sharded_attention_call(fn, mesh, seq_axis, batch_axis)
+
+
+# ------------------------------------------------- sequence-parallel ViT
+
+
+def sequence_vit_apply(
+    model,
+    variables,
+    images: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    seq_impl: str = "ring",
+    seq_axis: str = MODEL_AXIS,
+    batch_axis: str | None = DATA_AXIS,
+) -> jnp.ndarray:
+    """Forward a zoo ViT with its trunk sequence-parallel over ``seq_axis``.
+
+    The token axis is sharded across the mesh axis for the whole trunk:
+    LayerNorms and MLPs are per-token (no communication), and attention
+    runs as ring attention (``seq_impl="ring"``) or Ulysses all-to-all
+    (``"ulysses"``) via the block's ``attn_impl`` dispatch.  Embed and
+    head run as ordinary data-parallel computations via the model's own
+    methods — semantically identical to ``model.apply(variables, images)``
+    for any shard count.
+    """
+    import flax.linen as nn
+
+    from ..models.vit import ViTBlock
+
+    p_size = mesh.shape[seq_axis]
+    tokens = model.apply(variables, images, method="embed")
+    s = tokens.shape[1]
+    if s % p_size:
+        raise ValueError(
+            f"sequence length {s} not divisible by the {seq_axis} axis "
+            f"({p_size})"
+        )
+    if seq_impl == "ulysses" and model.heads % p_size:
+        raise ValueError(
+            f"ulysses needs heads ({model.heads}) divisible by the "
+            f"{seq_axis} axis ({p_size})"
+        )
+
+    block_cls = ViTBlock
+    if model.remat:  # honor --remat inside the sequence-parallel trunk
+        block_cls = nn.remat(ViTBlock, prevent_cse=False)
+    block = block_cls(
+        dim=model.dim,
+        heads=model.heads,
+        mlp_ratio=model.mlp_ratio,
+        dtype=model.dtype,
+        norm_dtype=model.norm_dtype,
+        attn_impl=f"{seq_impl}:{seq_axis}",
+    )
+
+    def local_trunk(stacked_params, x):
+        def body(c, layer_params):
+            y, _ = block.apply({"params": layer_params}, c, None)
+            return y, None
+
+        x, _ = jax.lax.scan(body, x, stacked_params)
+        return x
+
+    stacked = variables["params"]["blocks"]
+    x_spec = P(batch_axis, seq_axis, None)
+    staged = shard_map(
+        local_trunk,
+        mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(), stacked), x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )
+    y = staged(stacked, tokens)
+    return model.apply(variables, y, method="head_out")
+
+
+def make_sequence_apply_fn(model, mesh: Mesh, *, seq_impl: str = "ring"):
+    """An ``apply_fn`` drop-in for ``TrainState`` running the
+    sequence-parallel forward with the train step's calling conventions."""
+
+    def apply_fn(variables, x, train=False, mutable=()):
+        logits = sequence_vit_apply(model, variables, x, mesh, seq_impl=seq_impl)
+        return (logits, {}) if mutable else logits
+
+    return apply_fn

@@ -40,6 +40,10 @@ from .mesh import MODEL_AXIS
 # module name prefixes whose blocks are tensor-parallelized
 _TP_STAGES = ("stage3_", "stage4_")
 
+# submodules identifying a ViT scanned-trunk param tree (models/vit.py);
+# leaves carry a leading (depth,) stack axis
+_VIT_BLOCK_KEYS = {"qkv", "proj", "mlp_up", "mlp_down"}
+
 _REPL = P()
 
 
@@ -70,6 +74,28 @@ def _block_specs(block_params: dict[str, Any]) -> dict[str, Any]:
     return specs
 
 
+def _vit_trunk_specs(blocks: dict[str, Any]) -> dict[str, Any]:
+    """Megatron layout for the scanned ViT trunk (leaves ``(depth, ...)``):
+    qkv and mlp_up are column-parallel (output features sharded — qkv is
+    packed head-major in ``models/vit.py``, so the shard boundaries fall on
+    whole (q,k,v) head triples and attention runs head-local when heads %
+    model_parallel == 0); proj and mlp_down are row-parallel (input
+    contracted over the sharded dim — GSPMD emits the psum); their biases
+    and the LayerNorms are replicated, so both residual adds need no
+    reshard."""
+    col = {"kernel": P(None, None, MODEL_AXIS), "bias": P(None, MODEL_AXIS)}
+    row = {"kernel": P(None, MODEL_AXIS, None), "bias": P(None)}
+    specs: dict[str, Any] = {}
+    for name, sub in blocks.items():
+        if name in ("qkv", "mlp_up"):
+            specs[name] = col
+        elif name in ("proj", "mlp_down"):
+            specs[name] = row
+        else:  # ln_attn / ln_mlp
+            specs[name] = jax.tree_util.tree_map(lambda _: _REPL, sub)
+    return specs
+
+
 def param_partition_specs(params: dict[str, Any]) -> dict[str, Any]:
     """Params-shaped tree of ``PartitionSpec``s implementing the TP layout."""
     specs: dict[str, Any] = {}
@@ -78,6 +104,12 @@ def param_partition_specs(params: dict[str, Any]) -> dict[str, Any]:
             specs[mod] = {"kernel": P(None, MODEL_AXIS), "bias": P(MODEL_AXIS)}
         elif mod.startswith(_TP_STAGES):
             specs[mod] = _block_specs(sub)
+        elif (
+            mod == "blocks"
+            and isinstance(sub, dict)
+            and _VIT_BLOCK_KEYS <= set(sub)
+        ):
+            specs[mod] = _vit_trunk_specs(sub)
         else:
             specs[mod] = jax.tree_util.tree_map(lambda _: _REPL, sub)
     return specs

@@ -169,25 +169,29 @@ class Trainer:
             state = create_train_state(
                 self.model, init_key, self.tx, input_shape=(1, size, size, 3)
             )
-        # The "model" axis's meaning is the --parallel-style: tensor
-        # parallelism (Megatron param sharding, the default) or a GPipe
-        # pipeline over the stacked transformer trunk.  Both degenerate to
-        # fully-replicated at model_parallel == 1, so one placement path
-        # serves every variant.
+        # The "model" axis's meaning is the --parallel-style: 'tensor'
+        # (Megatron param sharding, the default), 'pipeline' (GPipe over
+        # the stacked transformer trunk, stage-sharded params), or
+        # 'sequence'/'sequence-ulysses' (token axis sharded across the
+        # trunk; params stay fully replicated — sequence parallelism
+        # shards activations, not parameters).  At model_parallel == 1
+        # every style degenerates to the replicated tensor path.
         style = getattr(hparams, "parallel_style", "tensor")
         mp_size = self.mesh.shape["model"]
-        if style == "pipeline" and mp_size > 1:
+        if style != "tensor" and mp_size > 1:
             from ..models.vit import ViT
+
+            if not isinstance(self.model, ViT):
+                raise ValueError(
+                    f"--parallel-style {style} needs a stacked transformer "
+                    f"trunk (vit_* models); got --model {hparams.model}"
+                )
+        if style == "pipeline" and mp_size > 1:
             from ..parallel.pipeline import (
                 make_pipelined_apply_fn,
                 pp_state_shardings,
             )
 
-            if not isinstance(self.model, ViT):
-                raise ValueError(
-                    "--parallel-style pipeline needs a stacked transformer "
-                    f"trunk (vit_* models); got --model {hparams.model}"
-                )
             micro = getattr(hparams, "pipeline_microbatches", 0) or 4 * mp_size
             per_micro = hparams.batch_size // self.grad_accum
             if per_micro % (micro * n_data):
@@ -202,6 +206,21 @@ class Trainer:
                 )
             )
             self.state_sharding = pp_state_shardings(self.mesh, state)
+        elif style.startswith("sequence") and mp_size > 1:
+            from ..parallel.ring import make_sequence_apply_fn
+            from ..parallel.sharding import replicated_sharding
+
+            seq_impl = "ulysses" if style == "sequence-ulysses" else "ring"
+            state = state.replace(
+                apply_fn=make_sequence_apply_fn(
+                    self.model, self.mesh, seq_impl=seq_impl
+                )
+            )
+            # sequence parallelism shards activations, not parameters
+            repl = replicated_sharding(self.mesh)
+            self.state_sharding = jax.tree_util.tree_map(
+                lambda _: repl, state
+            )
         else:
             self.state_sharding = state_shardings(self.mesh, state)
         self.state = place_tree(state, self.state_sharding)

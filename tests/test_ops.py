@@ -102,6 +102,10 @@ def test_attention_dispatcher():
     assert float(jnp.max(jnp.abs(out_auto - out_ref))) == 0.0
     with pytest.raises(ValueError, match="unknown attention impl"):
         attention(q, k, v, impl="nope")
+    # near-miss sequence-parallel names must fail fast, not silently route
+    for typo in ("ring_attn", "rings", "ulysses2"):
+        with pytest.raises(ValueError, match="unknown attention impl"):
+            attention(q, k, v, impl=typo)
 
 
 @pytest.mark.parametrize("causal", [False, True])

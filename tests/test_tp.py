@@ -146,3 +146,56 @@ def test_tp_training_matches_dp_trajectory():
     # partitioned-reduction ordering differences (observed ≤0.4% at step 3)
     np.testing.assert_allclose(losses[1][:1], losses[2][:1], rtol=1e-5)
     np.testing.assert_allclose(losses[1], losses[2], rtol=2e-2)
+
+
+def test_vit_trunk_specs_megatron_layout():
+    """ViT scanned trunk: qkv/mlp_up column-parallel, proj/mlp_down
+    row-parallel, LayerNorms and biases-of-row layers replicated."""
+    state = _make_state("vit_tiny")
+    specs = param_partition_specs(state.params)
+    b = specs["blocks"]
+    assert b["qkv"]["kernel"] == jax.sharding.PartitionSpec(None, None, "model")
+    assert b["qkv"]["bias"] == jax.sharding.PartitionSpec(None, "model")
+    assert b["proj"]["kernel"] == jax.sharding.PartitionSpec(None, "model", None)
+    assert b["proj"]["bias"] == jax.sharding.PartitionSpec(None)
+    assert b["mlp_up"]["kernel"] == jax.sharding.PartitionSpec(None, None, "model")
+    assert b["mlp_down"]["kernel"] == jax.sharding.PartitionSpec(None, "model", None)
+    assert b["ln_attn"]["scale"] == jax.sharding.PartitionSpec()
+    # embed/pos/head outside the trunk
+    assert specs["pos_emb"] == jax.sharding.PartitionSpec()
+    assert specs["head"]["kernel"] == jax.sharding.PartitionSpec(None, "model")
+
+
+def test_vit_tp_training_matches_dp_trajectory():
+    """Same data, same init: ViT under (4,2) tensor parallelism tracks the
+    (8,1) data-parallel trajectory (heads divide the model axis, so qkv
+    sharding is head-aligned)."""
+    from distributed_training_comparison_tpu.models import ViT
+
+    rng = np.random.default_rng(1)
+    images = rng.integers(0, 255, size=(64, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 100, size=(64,), dtype=np.int32)
+    model = ViT(depth=2, dim=64, heads=4, patch=8)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=10)
+
+    losses = {}
+    for mp in (1, 2):
+        mesh = parallel.make_mesh(8, mp, backend="tpu")
+        state = create_train_state(model, jax.random.key(0), tx)
+        placed, sh = _placed(mesh, state)
+        if mp == 2:
+            assert not placed.params["blocks"]["qkv"][
+                "kernel"
+            ].sharding.is_fully_replicated
+        step = make_train_step(
+            mesh, precision="fp32", augment=False, state_sharding=sh
+        )
+        bx, by = parallel.shard_batch((images, labels), mesh)
+        traj = []
+        for i in range(3):
+            placed, metrics = step(placed, bx, by, jax.random.key(7))
+            traj.append(float(metrics["loss"]))
+        losses[mp] = traj
+
+    np.testing.assert_allclose(losses[1][:1], losses[2][:1], rtol=1e-5)
+    np.testing.assert_allclose(losses[1], losses[2], rtol=2e-2)
