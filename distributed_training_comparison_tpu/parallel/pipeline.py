@@ -151,12 +151,17 @@ def make_pipelined_apply_fn(model, mesh: Mesh, *, num_microbatches: int):
     return apply_fn
 
 
-def vit_stage_fn(model) -> Callable[[Any, jnp.ndarray], jnp.ndarray]:
-    """A pipeline stage for a zoo ViT: scan this stage's block slice.
+def vit_stage_fn(
+    model, *, attn_impl: str | None = None
+) -> Callable[[Any, jnp.ndarray], jnp.ndarray]:
+    """Scan a slice of a zoo ViT's stacked block params over its input.
 
     The stage applies the *same* ``ViTBlock`` module the model's scanned
-    trunk uses, on slices of the model's own stacked parameters — so the
-    staged trunk can never diverge from ``model.trunk``.
+    trunk uses, on slices of the model's own stacked parameters — so a
+    staged/sharded trunk can never diverge from ``model.trunk``.  Shared
+    by pipeline parallelism (per-stage layer slices) and sequence
+    parallelism (full stack, ``attn_impl`` overridden to the
+    sequence-parallel dispatch).
     """
     from ..models.vit import ViTBlock
 
@@ -169,7 +174,7 @@ def vit_stage_fn(model) -> Callable[[Any, jnp.ndarray], jnp.ndarray]:
         mlp_ratio=model.mlp_ratio,
         dtype=model.dtype,
         norm_dtype=model.norm_dtype,
-        attn_impl=model.attn_impl,
+        attn_impl=model.attn_impl if attn_impl is None else attn_impl,
     )
 
     def stage(local_params, x):

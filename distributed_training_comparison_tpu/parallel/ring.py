@@ -213,9 +213,7 @@ def sequence_vit_apply(
     methods — semantically identical to ``model.apply(variables, images)``
     for any shard count.
     """
-    import flax.linen as nn
-
-    from ..models.vit import ViTBlock
+    from .pipeline import vit_stage_fn
 
     p_size = mesh.shape[seq_axis]
     tokens = model.apply(variables, images, method="embed")
@@ -231,26 +229,7 @@ def sequence_vit_apply(
             f"{seq_axis} axis ({p_size})"
         )
 
-    block_cls = ViTBlock
-    if model.remat:  # honor --remat inside the sequence-parallel trunk
-        block_cls = nn.remat(ViTBlock, prevent_cse=False)
-    block = block_cls(
-        dim=model.dim,
-        heads=model.heads,
-        mlp_ratio=model.mlp_ratio,
-        dtype=model.dtype,
-        norm_dtype=model.norm_dtype,
-        attn_impl=f"{seq_impl}:{seq_axis}",
-    )
-
-    def local_trunk(stacked_params, x):
-        def body(c, layer_params):
-            y, _ = block.apply({"params": layer_params}, c, None)
-            return y, None
-
-        x, _ = jax.lax.scan(body, x, stacked_params)
-        return x
-
+    local_trunk = vit_stage_fn(model, attn_impl=f"{seq_impl}:{seq_axis}")
     stacked = variables["params"]["blocks"]
     x_spec = P(batch_axis, seq_axis, None)
     staged = shard_map(

@@ -28,6 +28,23 @@ from .state import TrainState
 BEST_PREFIX = "best_model_"
 LAST_NAME = "last.ckpt"
 
+# Checkpoint payload format.  2: ViT qkv kernels are packed head-major
+# (models/vit.py) — format-1 ViT checkpoints have q/k/v-major qkv columns
+# and would load shape-compatibly but compute garbage attention.
+CKPT_FMT = 2
+
+
+def _check_ckpt_fmt(raw: dict, params, path) -> None:
+    fmt = raw.get("fmt", 1)
+    is_vit = isinstance(params, dict) and "qkv" in params.get("blocks", {})
+    if fmt < 2 and is_vit:
+        raise ValueError(
+            f"{path} is a format-{fmt} ViT checkpoint from before the "
+            "head-major qkv repacking; its qkv kernel columns are q/k/v-"
+            "major and would silently produce wrong attention. Retrain, or "
+            "permute the qkv kernel/bias columns to head-major and re-save."
+        )
+
 
 def find_version_dir(ckpt_root: str | Path, create: bool = True) -> Path:
     """First nonexistent ``version-{n}`` under ``ckpt_root`` (reference
@@ -66,6 +83,7 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
     """
     version_dir = Path(version_dir)
     payload = {
+        "fmt": CKPT_FMT,
         "params": serialization.to_state_dict(fetch_to_host(state.params)),
         "batch_stats": serialization.to_state_dict(fetch_to_host(state.batch_stats)),
         "epoch": epoch,
@@ -87,6 +105,7 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
 def load_checkpoint(path: str | Path, state: TrainState) -> TrainState:
     """Restore params/batch_stats from a best checkpoint into ``state``."""
     raw = serialization.msgpack_restore(Path(path).read_bytes())
+    _check_ckpt_fmt(raw, state.params, path)
     params = serialization.from_state_dict(state.params, raw["params"])
     batch_stats = serialization.from_state_dict(state.batch_stats, raw["batch_stats"])
     return state.replace(params=params, batch_stats=batch_stats)
@@ -124,6 +143,7 @@ def save_resume_state(
 ) -> Path:
     """Write the fully-resumable ``last.ckpt`` (capability the reference lacks)."""
     payload = {
+        "fmt": CKPT_FMT,
         "state": serialization.to_state_dict(fetch_to_host(_state_dict(state))),
         "epoch": epoch,
         "best_acc": float(best_acc),
@@ -138,6 +158,7 @@ def save_resume_state(
 def load_resume_state(path: str | Path, state: TrainState) -> tuple[TrainState, int, float]:
     """Restore ``(state, next_epoch, best_acc)`` from a ``last.ckpt``."""
     raw = serialization.msgpack_restore(Path(path).read_bytes())
+    _check_ckpt_fmt(raw, state.params, path)
     restored = serialization.from_state_dict(_state_dict(state), raw["state"])
     state = state.replace(
         step=restored["step"],

@@ -77,6 +77,42 @@ def test_two_process_distributed_train_step():
     assert results[0]["l2"] == results[1]["l2"]
 
 
+def test_two_process_pipeline_parallel_trainer(tmp_path):
+    """Pipeline parallelism with the two stages on different processes:
+    every GPipe activation handoff is a cross-process ppermute, and the
+    stage-sharded stacked params exercise the symmetric checkpoint fetch."""
+    port = _free_port()
+    env = _worker_env()
+    worker = Path(__file__).parent / "mh_pp_worker.py"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(rank), str(port), str(tmp_path)],
+            env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][0]
+        kv = dict(item.split("=") for item in line.split()[1:])
+        results[int(kv["rank"])] = kv
+    assert set(results) == {0, 1}
+    assert results[0]["loss"] == results[1]["loss"]
+    vdir = tmp_path / f"version-{results[0]['version']}"
+    assert (vdir / "last.ckpt").exists()
+
+
 def test_two_process_trainer_fit_ckpt_test(tmp_path):
     """Full Trainer path over 2 processes with cross-process tensor
     parallelism: fit (symmetric TP state fetch + process-0 checkpoint

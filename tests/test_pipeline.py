@@ -160,6 +160,67 @@ def test_trainer_pipeline_style_matches_baseline(tmp_path):
     np.testing.assert_allclose(piped, base, atol=5e-4)
 
 
+def test_pipeline_composes_with_grad_accum(vit_and_vars):
+    """PP x grad-accum: the staged apply under 2 sequential micro-batches
+    must match the unsharded single-shot update exactly (ViT is BN-free,
+    so accumulation is exact)."""
+    from distributed_training_comparison_tpu.parallel import (
+        make_pipelined_apply_fn,
+        place_tree,
+        replicated_sharding,
+        shard_batch,
+    )
+    from distributed_training_comparison_tpu.train import (
+        configure_optimizers,
+        create_train_state,
+        make_train_step,
+    )
+
+    class HP:
+        lr = 0.1
+        weight_decay = 1e-4
+        lr_decay_step_size = 25
+        lr_decay_gamma = 0.1
+
+    model, _, _ = vit_and_vars
+    rng = np.random.default_rng(3)
+    images = rng.integers(0, 255, size=(64, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 100, size=(64,), dtype=np.int32)
+
+    results = {}
+    with jax.default_matmul_precision("highest"):
+        for tag, mp, accum in (("base", 1, 1), ("pp+accum", 4, 2)):
+            mesh = make_mesh(8, mp)
+            tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+            state = create_train_state(model, jax.random.key(0), tx)
+            if mp > 1:
+                state = state.replace(
+                    apply_fn=make_pipelined_apply_fn(
+                        model, mesh, num_microbatches=2
+                    )
+                )
+                sharding = pp_state_shardings(mesh, state)
+                state = place_tree(state, sharding)
+            else:
+                sharding = None
+                state = jax.device_put(state, replicated_sharding(mesh))
+            step = make_train_step(
+                mesh, augment=False, state_sharding=sharding, grad_accum=accum
+            )
+            bx, by = shard_batch((images, labels), mesh)
+            new_state, metrics = step(state, bx, by, jax.random.key(1))
+            results[tag] = (
+                jax.device_get(new_state.params), float(metrics["loss"])
+            )
+    (p_base, l_base), (p_pp, l_pp) = results["base"], results["pp+accum"]
+    assert l_base == pytest.approx(l_pp, rel=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        p_base,
+        p_pp,
+    )
+
+
 def test_trainer_pipeline_rejects_resnet(tmp_path):
     hp = load_config(
         "tpu",

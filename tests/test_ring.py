@@ -169,6 +169,60 @@ def test_trainer_sequence_style_matches_baseline(tmp_path):
     np.testing.assert_allclose(seq, base, atol=5e-4)
 
 
+def test_sequence_composes_with_grad_accum():
+    """SP x grad-accum: sequence-sharded trunk under 2 sequential
+    micro-batches matches the unsharded single-shot update exactly."""
+    import numpy as np
+
+    from distributed_training_comparison_tpu.models import ViT
+    from distributed_training_comparison_tpu.parallel import (
+        make_sequence_apply_fn,
+        replicated_sharding,
+        shard_batch,
+    )
+    from distributed_training_comparison_tpu.train import (
+        configure_optimizers,
+        create_train_state,
+        make_train_step,
+    )
+
+    class HP:
+        lr = 0.1
+        weight_decay = 1e-4
+        lr_decay_step_size = 25
+        lr_decay_gamma = 0.1
+
+    model = ViT(depth=4, dim=32, heads=4, patch=4)
+    rng = np.random.default_rng(5)
+    images = rng.integers(0, 255, size=(64, 32, 32, 3), dtype=np.uint8)
+    labels = rng.integers(0, 100, size=(64,), dtype=np.int32)
+
+    results = {}
+    with jax.default_matmul_precision("highest"):
+        for tag, mp, accum in (("base", 1, 1), ("sp+accum", 4, 2)):
+            mesh = make_mesh(8, mp)
+            tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+            state = create_train_state(model, jax.random.key(0), tx)
+            if mp > 1:
+                state = state.replace(
+                    apply_fn=make_sequence_apply_fn(model, mesh)
+                )
+            state = jax.device_put(state, replicated_sharding(mesh))
+            step = make_train_step(mesh, augment=False, grad_accum=accum)
+            bx, by = shard_batch((images, labels), mesh)
+            new_state, metrics = step(state, bx, by, jax.random.key(1))
+            results[tag] = (
+                jax.device_get(new_state.params), float(metrics["loss"])
+            )
+    (p_base, l_base), (p_sp, l_sp) = results["base"], results["sp+accum"]
+    assert abs(l_base - l_sp) < 1e-5 * max(1.0, abs(l_base))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6),
+        p_base,
+        p_sp,
+    )
+
+
 def test_ring_jits_under_jit(qkv):
     """The shard_map'd ring composes with an outer jit (how a train step
     would embed it)."""

@@ -100,6 +100,53 @@ def test_config_accepts_vit_models():
     assert hp.model == "vit_small"
 
 
+def test_format1_vit_checkpoint_rejected(tmp_path):
+    """A pre-head-major-qkv (format-1) ViT checkpoint must fail loudly:
+    shapes match the new layout, so silent loading would compute garbage
+    attention."""
+    from flax import serialization
+
+    from distributed_training_comparison_tpu.train.checkpoint import (
+        load_checkpoint,
+        load_resume_state,
+        save_checkpoint,
+    )
+    from distributed_training_comparison_tpu.train import (
+        configure_optimizers,
+        create_train_state,
+    )
+
+    class HP:
+        lr = 0.1
+        weight_decay = 1e-4
+        lr_decay_step_size = 25
+        lr_decay_gamma = 0.1
+
+    model = ViT(depth=2, dim=32, heads=2, patch=8)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+    state = create_train_state(model, jax.random.key(0), tx)
+
+    # current-format roundtrip works
+    path = save_checkpoint(tmp_path, state, epoch=0, val_acc=1.0)
+    load_checkpoint(path, state)
+
+    # strip the fmt field → format-1 file → must be rejected for ViT
+    raw = serialization.msgpack_restore(path.read_bytes())
+    del raw["fmt"]
+    old = tmp_path / "old.ckpt"
+    old.write_bytes(serialization.msgpack_serialize(raw))
+    with pytest.raises(ValueError, match="format-1 ViT"):
+        load_checkpoint(old, state)
+    fake_last = tmp_path / "last.ckpt"
+    fake_last.write_bytes(
+        serialization.msgpack_serialize(
+            {"state": {}, "epoch": 0, "best_acc": 0.0}
+        )
+    )
+    with pytest.raises(ValueError, match="format-1 ViT"):
+        load_resume_state(fake_last, state)
+
+
 def test_trainer_plumbs_image_size_to_vit(tmp_path):
     """--image-size must reach the ViT's position embedding (it is sized in
     setup(), unlike the resolution-agnostic ResNets)."""
