@@ -125,7 +125,7 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         choices=["tensor", "pipeline", "sequence", "sequence-ulysses"],
         help="How the model axis is used when --model-parallel > 1: "
         "'tensor' = Megatron-style channel sharding (ResNet stages 3-4 + "
-        "head, or the ViT trunk's qkv/proj/mlp pairs); 'pipeline' = GPipe "
+        "head, or the ViT trunk's q/k/v/proj/mlp pairs); 'pipeline' = GPipe "
         "microbatch pipeline over the stacked transformer trunk; "
         "'sequence' / 'sequence-ulysses' = shard the token axis across the "
         "trunk with ring attention / Ulysses all-to-all (vit_* models only)",

@@ -42,7 +42,7 @@ _TP_STAGES = ("stage3_", "stage4_")
 
 # submodules identifying a ViT scanned-trunk param tree (models/vit.py);
 # leaves carry a leading (depth,) stack axis
-_VIT_BLOCK_KEYS = {"qkv", "proj", "mlp_up", "mlp_down"}
+_VIT_BLOCK_KEYS = {"q_proj", "k_proj", "v_proj", "proj", "mlp_up", "mlp_down"}
 
 _REPL = P()
 
@@ -76,18 +76,17 @@ def _block_specs(block_params: dict[str, Any]) -> dict[str, Any]:
 
 def _vit_trunk_specs(blocks: dict[str, Any]) -> dict[str, Any]:
     """Megatron layout for the scanned ViT trunk (leaves ``(depth, ...)``):
-    qkv and mlp_up are column-parallel (output features sharded — qkv is
-    packed head-major in ``models/vit.py``, so the shard boundaries fall on
-    whole (q,k,v) head triples and attention runs head-local when heads %
-    model_parallel == 0); proj and mlp_down are row-parallel (input
-    contracted over the sharded dim — GSPMD emits the psum); their biases
-    and the LayerNorms are replicated, so both residual adds need no
-    reshard."""
+    q/k/v projections and mlp_up are column-parallel (output features
+    sharded; each projection's output axis splits on whole heads whenever
+    heads % model_parallel == 0, so attention runs head-local); proj and
+    mlp_down are row-parallel (input contracted over the sharded dim —
+    GSPMD emits the psum); their biases and the LayerNorms are replicated,
+    so both residual adds need no reshard."""
     col = {"kernel": P(None, None, MODEL_AXIS), "bias": P(None, MODEL_AXIS)}
     row = {"kernel": P(None, MODEL_AXIS, None), "bias": P(None)}
     specs: dict[str, Any] = {}
     for name, sub in blocks.items():
-        if name in ("qkv", "mlp_up"):
+        if name in ("q_proj", "k_proj", "v_proj", "mlp_up"):
             specs[name] = col
         elif name in ("proj", "mlp_down"):
             specs[name] = row

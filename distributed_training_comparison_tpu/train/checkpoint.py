@@ -28,21 +28,24 @@ from .state import TrainState
 BEST_PREFIX = "best_model_"
 LAST_NAME = "last.ckpt"
 
-# Checkpoint payload format.  2: ViT qkv kernels are packed head-major
-# (models/vit.py) — format-1 ViT checkpoints have q/k/v-major qkv columns
-# and would load shape-compatibly but compute garbage attention.
-CKPT_FMT = 2
+# Checkpoint payload format.  3: the ViT attention input projections are
+# three separate q_proj/k_proj/v_proj Denses (models/vit.py).  Formats 1-2
+# used one packed 3*dim qkv Dense (format 1 q/k/v-major, format 2
+# head-major); those checkpoints are structurally and semantically
+# incompatible with the current trunk.
+CKPT_FMT = 3
 
 
 def _check_ckpt_fmt(raw: dict, params, path) -> None:
     fmt = raw.get("fmt", 1)
-    is_vit = isinstance(params, dict) and "qkv" in params.get("blocks", {})
-    if fmt < 2 and is_vit:
+    is_vit = isinstance(params, dict) and "q_proj" in params.get("blocks", {})
+    if fmt < CKPT_FMT and is_vit:
         raise ValueError(
             f"{path} is a format-{fmt} ViT checkpoint from before the "
-            "head-major qkv repacking; its qkv kernel columns are q/k/v-"
-            "major and would silently produce wrong attention. Retrain, or "
-            "permute the qkv kernel/bias columns to head-major and re-save."
+            "split q/k/v projections (current format "
+            f"{CKPT_FMT}); its packed qkv kernel cannot be loaded into the "
+            "current trunk. Retrain, or split the packed qkv columns into "
+            "q_proj/k_proj/v_proj and re-save."
         )
 
 

@@ -113,10 +113,10 @@ def test_pp_state_shardings_partition_the_trunk(vit_and_vars):
     tx, _ = configure_optimizers(HP, steps_per_epoch=10)
     state = create_train_state(model, jax.random.key(0), tx)
     placed = place_tree(state, pp_state_shardings(mesh, state))
-    qkv = placed.params["blocks"]["qkv"]["kernel"]
-    assert not qkv.sharding.is_fully_replicated
+    qk = placed.params["blocks"]["q_proj"]["kernel"]
+    assert not qk.sharding.is_fully_replicated
     # each of the 4 stages holds 2 of the 8 stacked layers
-    assert {s.data.shape[0] for s in qkv.addressable_shards} == {2}
+    assert {s.data.shape[0] for s in qk.addressable_shards} == {2}
     # embed/head replicated
     assert placed.params["patch_embed"]["kernel"].sharding.is_fully_replicated
     # momentum mirrors the param layout (suffix matching)

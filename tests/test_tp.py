@@ -149,13 +149,14 @@ def test_tp_training_matches_dp_trajectory():
 
 
 def test_vit_trunk_specs_megatron_layout():
-    """ViT scanned trunk: qkv/mlp_up column-parallel, proj/mlp_down
+    """ViT scanned trunk: q/k/v/mlp_up column-parallel, proj/mlp_down
     row-parallel, LayerNorms and biases-of-row layers replicated."""
     state = _make_state("vit_tiny")
     specs = param_partition_specs(state.params)
     b = specs["blocks"]
-    assert b["qkv"]["kernel"] == jax.sharding.PartitionSpec(None, None, "model")
-    assert b["qkv"]["bias"] == jax.sharding.PartitionSpec(None, "model")
+    for name in ("q_proj", "k_proj", "v_proj"):
+        assert b[name]["kernel"] == jax.sharding.PartitionSpec(None, None, "model")
+        assert b[name]["bias"] == jax.sharding.PartitionSpec(None, "model")
     assert b["proj"]["kernel"] == jax.sharding.PartitionSpec(None, "model", None)
     assert b["proj"]["bias"] == jax.sharding.PartitionSpec(None)
     assert b["mlp_up"]["kernel"] == jax.sharding.PartitionSpec(None, None, "model")
@@ -168,8 +169,8 @@ def test_vit_trunk_specs_megatron_layout():
 
 def test_vit_tp_training_matches_dp_trajectory():
     """Same data, same init: ViT under (4,2) tensor parallelism tracks the
-    (8,1) data-parallel trajectory (heads divide the model axis, so qkv
-    sharding is head-aligned)."""
+    (8,1) data-parallel trajectory (heads divide the model axis, so the
+    q/k/v projection sharding is head-aligned)."""
     from distributed_training_comparison_tpu.models import ViT
 
     rng = np.random.default_rng(1)
@@ -184,7 +185,7 @@ def test_vit_tp_training_matches_dp_trajectory():
         state = create_train_state(model, jax.random.key(0), tx)
         placed, sh = _placed(mesh, state)
         if mp == 2:
-            assert not placed.params["blocks"]["qkv"][
+            assert not placed.params["blocks"]["q_proj"][
                 "kernel"
             ].sharding.is_fully_replicated
         step = make_train_step(

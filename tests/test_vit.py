@@ -22,7 +22,7 @@ def _param_count(depth, dim, heads, patch, num_classes, tokens, mlp_ratio=4):
     pos = tokens * dim
     per_block = (
         2 * 2 * dim  # two LayerNorms (scale+bias)
-        + dim * 3 * dim + 3 * dim  # qkv
+        + 3 * (dim * dim + dim)  # q/k/v projections
         + dim * dim + dim  # proj
         + dim * mlp_ratio * dim + mlp_ratio * dim  # mlp up
         + mlp_ratio * dim * dim + dim  # mlp down
@@ -101,9 +101,8 @@ def test_config_accepts_vit_models():
 
 
 def test_format1_vit_checkpoint_rejected(tmp_path):
-    """A pre-head-major-qkv (format-1) ViT checkpoint must fail loudly:
-    shapes match the new layout, so silent loading would compute garbage
-    attention."""
+    """A packed-qkv-era (format < 3) ViT checkpoint must fail loudly with
+    the format explanation, not a confusing structure mismatch."""
     from flax import serialization
 
     from distributed_training_comparison_tpu.train.checkpoint import (
@@ -140,10 +139,10 @@ def test_format1_vit_checkpoint_rejected(tmp_path):
     fake_last = tmp_path / "last.ckpt"
     fake_last.write_bytes(
         serialization.msgpack_serialize(
-            {"state": {}, "epoch": 0, "best_acc": 0.0}
+            {"fmt": 2, "state": {}, "epoch": 0, "best_acc": 0.0}
         )
     )
-    with pytest.raises(ValueError, match="format-1 ViT"):
+    with pytest.raises(ValueError, match="format-2 ViT"):
         load_resume_state(fake_last, state)
 
 
