@@ -110,6 +110,11 @@ class ViT(nn.Module):
     stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
 
     def setup(self):
+        if self.dim % self.heads:
+            raise ValueError(
+                f"ViT dim ({self.dim}) must be divisible by heads "
+                f"({self.heads}); per-head dim would not be integral"
+            )
         xavier = nn.initializers.xavier_uniform()
         self.patch_embed = nn.Conv(
             self.dim,

@@ -422,6 +422,7 @@ def attention(
     impl: str = "auto",
     return_lse: bool = False,
     layout: str = "bhsd",
+    interpret: bool = False,
 ):
     """Dispatch: Pallas kernel on TPU for non-trivial sequences, jnp
     reference elsewhere (CPU CI, tiny sequences where one fused XLA softmax
@@ -468,9 +469,17 @@ def attention(
             else "reference"
         )
     if impl == "pallas":
+        if not interpret and jax.default_backend() != "tpu":
+            raise ValueError(
+                "attention(impl='pallas') requires a TPU backend (current: "
+                f"{jax.default_backend()!r}). Pass interpret=True to run the "
+                "kernel through the Pallas interpreter off-TPU, or use "
+                "impl='reference'/'auto'."
+            )
         out = flash_attention(
             to_bhsd(q), to_bhsd(k), to_bhsd(v),
             causal=causal, scale=scale, return_lse=return_lse,
+            interpret=interpret,
         )
         if return_lse:
             return to_bhsd(out[0]), out[1]

@@ -17,6 +17,7 @@ pickle is the reference's load path, ``src/single/main.py:25``).
 
 from __future__ import annotations
 
+import re
 from pathlib import Path
 from typing import Any
 
@@ -134,11 +135,42 @@ def find_latest_resume(ckpt_root: str | Path) -> Path | None:
     return path if path.exists() else None
 
 
-def find_best_checkpoint(version_dir: str | Path) -> Path | None:
+def _best_sort_key(path: Path) -> tuple[int, float]:
+    """(epoch, acc) parsed from ``best_model_epoch_{e}_acc_{a}.ckpt``.
+
+    Numeric, not lexicographic: ``epoch_9`` must lose to ``epoch_10`` even
+    though it sorts after it as a string.  Unparseable names sort first so a
+    well-formed file always wins over a stray one."""
+    m = re.fullmatch(
+        rf"{BEST_PREFIX}epoch_(\d+)_acc_([0-9.]+)\.ckpt", path.name
+    )
+    if not m:
+        return (-1, -1.0)
+    try:
+        return (int(m.group(1)), float(m.group(2).rstrip(".")))
+    except ValueError:  # e.g. acc "1.2.3" — regex-matched but not a float
+        return (-1, -1.0)
+
+
+def find_best_checkpoint(version_dir: str | Path, cleanup: bool = True) -> Path | None:
     """Glob the best file like the reference's test phase
-    (``src/single/main.py:23-27``)."""
-    hits = sorted(Path(version_dir).glob(f"{BEST_PREFIX}*.ckpt"))
-    return hits[-1] if hits else None
+    (``src/single/main.py:23-27``) — but pick by numeric epoch (highest-acc
+    tiebreak), not string order.
+
+    Two best files can coexist in the crash window of ``save_checkpoint``
+    (new file written before old ones are unlinked); ``cleanup=True``
+    restores the one-best invariant by dropping the stale losers.  Only
+    files this module's own naming scheme accounts for are ever deleted —
+    a user's stray ``best_model_backup.ckpt`` is not ours to unlink."""
+    hits = sorted(Path(version_dir).glob(f"{BEST_PREFIX}*.ckpt"), key=_best_sort_key)
+    if not hits:
+        return None
+    best = hits[-1]
+    if cleanup:
+        for stale in hits[:-1]:
+            if _best_sort_key(stale) != (-1, -1.0):
+                stale.unlink(missing_ok=True)
+    return best
 
 
 def save_resume_state(

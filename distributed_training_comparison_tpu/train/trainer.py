@@ -186,6 +186,14 @@ class Trainer:
                     f"--parallel-style {style} needs a stacked transformer "
                     f"trunk (vit_* models); got --model {hparams.model}"
                 )
+            if style == "pipeline" and self.model.depth % mp_size:
+                # fail at the CLI, not from inside jit tracing of the
+                # staged trunk (advisor r2)
+                raise ValueError(
+                    f"--parallel-style pipeline needs model depth "
+                    f"({self.model.depth}) divisible by the model-parallel "
+                    f"mesh axis ({mp_size}) to form equal stages"
+                )
         if style == "pipeline" and mp_size > 1:
             from ..parallel.pipeline import (
                 make_pipelined_apply_fn,

@@ -28,6 +28,15 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from distributed_training_comparison_tpu.utils import (  # noqa: E402
+    enable_persistent_compilation_cache,
+)
+
+# Persistent executable cache: the fast gate (`pytest -m "not slow"`) is
+# dominated by CPU compiles of the zoo models; with the cache warm a repeat
+# run skips nearly all of them.
+enable_persistent_compilation_cache()
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _assert_virtual_mesh():

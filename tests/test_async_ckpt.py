@@ -63,6 +63,7 @@ def test_close_idempotent():
     w.close()
 
 
+@pytest.mark.slow
 def test_host_data_mode_end_to_end(tmp_path):
     """--data-mode host: streaming loader feeds the per-step compiled path;
     artifacts and metrics match the device-resident contract."""

@@ -237,6 +237,7 @@ def test_prefetch_loader_propagates_producer_errors():
         next(it)
 
 
+@pytest.mark.slow
 def test_sharded_train_loaders_disjoint_per_epoch():
     hp = HP()
     loaders = [
@@ -249,6 +250,7 @@ def test_sharded_train_loaders_disjoint_per_epoch():
     assert len(sizes) == 1  # lockstep: same steps on every shard
 
 
+@pytest.mark.slow
 def test_tst_loader_shards_cover_test_set_exactly():
     hp = HP()
     total = sum(

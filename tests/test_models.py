@@ -64,7 +64,15 @@ def rng():
     return jax.random.key(0)
 
 
-@pytest.mark.parametrize("name", list(DEPTHS))
+# fast gate: one basic-block + one bottleneck representative
+@pytest.mark.parametrize(
+    "name",
+    [
+        n if n in ("resnet18", "resnet50")
+        else pytest.param(n, marks=pytest.mark.slow)
+        for n in DEPTHS
+    ],
+)
 def test_shape_and_param_count(name, rng):
     model = get_model(name)
     x = jnp.zeros((2, 32, 32, 3))
@@ -148,6 +156,7 @@ def test_bf16_policy_fp32_logits(rng):
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_bn_stats_fp32_by_default_under_bf16(rng):
     """Under the bf16 policy, BN statistics reduce in fp32 by default
     (norm_dtype=fp32); norm_dtype=None opts back into compute-dtype stats.
@@ -181,6 +190,7 @@ def test_unknown_model_raises():
         get_model("alexnet")
 
 
+@pytest.mark.slow
 def test_remat_reduces_compiled_temp_memory(rng):
     """--remat must actually lower XLA's peak temp allocation for the
     backward pass (checked via compiled memory_analysis, no device run)."""

@@ -14,6 +14,10 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile: full-suite only
+
 WORKER = Path(__file__).parent / "mh_worker.py"
 REPO = Path(__file__).parent.parent
 

@@ -67,6 +67,7 @@ def test_host_local_batch_slice_single_host():
     assert host_local_batch_slice(256) == 256  # one process in CI
 
 
+@pytest.mark.slow
 def test_remat_tp_grad_accum_compose():
     """remat (nn.remat-wrapped blocks), tensor parallelism (name-keyed
     partition specs) and gradient accumulation must work together: remat

@@ -21,6 +21,8 @@ from distributed_training_comparison_tpu.parallel import (
 )
 from distributed_training_comparison_tpu.train import Trainer
 
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile: full-suite only
+
 
 MODEL_KW = dict(depth=8, dim=32, heads=2, patch=8)
 
@@ -233,3 +235,19 @@ def test_trainer_pipeline_rejects_resnet(tmp_path):
     )
     with pytest.raises(ValueError, match="pipeline"):
         Trainer(hp)
+
+
+def test_trainer_pipeline_rejects_indivisible_depth(tmp_path):
+    """depth % mp_size != 0 must fail at Trainer init with a CLI-level
+    message, not from inside jit tracing of the staged trunk (advisor r2)."""
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "256",
+            "--batch-size", "64", "--model", "vit_tiny",
+            "--model-parallel", "8", "--parallel-style", "pipeline",
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    with pytest.raises(ValueError, match="divisible by the model-parallel"):
+        Trainer(hp)  # vit_tiny depth=12, 12 % 8 != 0

@@ -19,6 +19,8 @@ from distributed_training_comparison_tpu.parallel import (
     make_ulysses_attention,
 )
 
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile: full-suite only
+
 B, H, S, D = 4, 8, 256, 32
 
 

@@ -23,6 +23,8 @@ from distributed_training_comparison_tpu.train import (
     make_train_step,
 )
 
+pytestmark = pytest.mark.slow  # multi-process / heavy-compile: full-suite only
+
 
 class HP:
     lr = 0.1

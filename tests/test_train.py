@@ -122,6 +122,7 @@ def test_epoch_runner_convergence_and_determinism(mesh, tiny_data):
     assert l1[-4:].mean() < l1[:4].mean()  # learning happened
 
 
+@pytest.mark.slow
 def test_epoch_runner_epochs_differ(mesh, tiny_data):
     x, y = tiny_data
     runner = make_epoch_runner(mesh, batch_size=64)
@@ -232,6 +233,37 @@ def test_best_checkpoint_policy_and_roundtrip(tmp_path, mesh):
         restored.params,
         state.params,
     )
+
+
+def test_best_checkpoint_numeric_epoch_sort(tmp_path):
+    """Crash-window scenario: two best files coexist; ``epoch_10`` must win
+    over ``epoch_9`` (lexicographic order picks the stale one) and the stale
+    file is cleaned up (VERDICT r2 weak #4)."""
+    vdir = tmp_path / "version-0"
+    vdir.mkdir()
+    stale = vdir / "best_model_epoch_9_acc_60.0000.ckpt"
+    fresh = vdir / "best_model_epoch_10_acc_61.0000.ckpt"
+    stale.write_bytes(b"stale")
+    fresh.write_bytes(b"fresh")
+    assert sorted(vdir.glob("*.ckpt"))[-1] == stale  # the old bug's pick
+    assert find_best_checkpoint(vdir) == fresh
+    assert not stale.exists()  # stale best cleaned up on discovery
+    assert fresh.exists()
+
+    # same-epoch tie breaks on accuracy
+    a = vdir / "best_model_epoch_10_acc_59.0000.ckpt"
+    a.write_bytes(b"a")
+    assert find_best_checkpoint(vdir, cleanup=False) == fresh
+    # unparseable stray names never beat a well-formed file — and cleanup
+    # never deletes a file the naming scheme doesn't account for (nor one
+    # whose acc field regex-matches but isn't a float)
+    stray = vdir / "best_model_backup.ckpt"
+    stray.write_bytes(b"s")
+    bad_acc = vdir / "best_model_epoch_3_acc_1.2.3.ckpt"
+    bad_acc.write_bytes(b"b")
+    assert find_best_checkpoint(vdir) == fresh
+    assert stray.exists() and bad_acc.exists()
+    assert not a.exists()  # the parseable loser IS cleaned up
 
 
 def test_resume_roundtrip(tmp_path, mesh, tiny_data):

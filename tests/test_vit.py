@@ -31,7 +31,7 @@ def _param_count(depth, dim, heads, patch, num_classes, tokens, mlp_ratio=4):
     return patch_embed + pos + depth * per_block + head
 
 
-@pytest.mark.parametrize("name,depth,dim,heads", [("vit_tiny", 12, 192, 3), ("vit_small", 12, 384, 6)])
+@pytest.mark.parametrize("name,depth,dim,heads", [("vit_tiny", 12, 192, 3), pytest.param("vit_small", 12, 384, 6, marks=pytest.mark.slow)])
 def test_param_count_matches_formula(name, depth, dim, heads):
     m = models.get_model(name)
     v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
@@ -50,6 +50,15 @@ def test_scanned_trunk_stacks_params():
         assert leaf.shape[0] == 12
 
 
+def test_vit_rejects_indivisible_heads():
+    """dim % heads != 0 must fail with a config-level error, not an opaque
+    reshape failure inside nn.scan (advisor r2)."""
+    m = ViT(depth=2, dim=100, heads=3)
+    with pytest.raises(ValueError, match="divisible by heads"):
+        m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
+
+
+@pytest.mark.slow
 def test_bf16_policy_keeps_params_and_logits_fp32():
     m = models.get_model("vit_tiny", dtype=jnp.bfloat16)
     v = m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
@@ -60,6 +69,7 @@ def test_bf16_policy_keeps_params_and_logits_fp32():
     assert out.shape == (2, 100) and out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_remat_preserves_forward():
     kw = dict(depth=2, dim=32, heads=2, patch=8)
     x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3), jnp.float32)
@@ -70,6 +80,7 @@ def test_remat_preserves_forward():
     np.testing.assert_allclose(np.asarray(out), np.asarray(out_r), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_trainer_end_to_end_vit(tmp_path):
     """fit → validate → test through the scanned SPMD programs with an
     (empty) batch_stats collection."""
@@ -100,6 +111,7 @@ def test_config_accepts_vit_models():
     assert hp.model == "vit_small"
 
 
+@pytest.mark.slow
 def test_format1_vit_checkpoint_rejected(tmp_path):
     """A packed-qkv-era (format < 3) ViT checkpoint must fail loudly with
     the format explanation, not a confusing structure mismatch."""
@@ -146,6 +158,7 @@ def test_format1_vit_checkpoint_rejected(tmp_path):
         load_resume_state(fake_last, state)
 
 
+@pytest.mark.slow
 def test_trainer_plumbs_image_size_to_vit(tmp_path):
     """--image-size must reach the ViT's position embedding (it is sized in
     setup(), unlike the resolution-agnostic ResNets)."""
