@@ -138,6 +138,17 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "(0 = auto: 4x the stage count; bubble fraction (P-1)/(M+P-1))",
     )
     parser.add_argument(
+        "--pipeline-schedule",
+        type=str,
+        default="gpipe",
+        choices=["gpipe", "1f1b"],
+        help="Pipeline schedule: 'gpipe' = all forwards then all backwards "
+        "(autodiff reverse; O(M) stashed microbatches per stage); '1f1b' = "
+        "one-forward-one-backward with per-stage activation recompute "
+        "(same bubble, O(P) stashed microbatches — the memory headroom "
+        "that lets M grow)",
+    )
+    parser.add_argument(
         "--precision",
         type=str,
         default=None,

@@ -52,6 +52,7 @@ from .ring import (
     ulysses_attention,
 )
 from .pipeline import (
+    make_1f1b_fwd_bwd,
     make_pipeline_trunk,
     make_pipelined_apply_fn,
     pipeline_stages,
@@ -84,6 +85,7 @@ __all__ = [
     "sequence_vit_apply",
     "make_sequence_apply_fn",
     "pipeline_stages",
+    "make_1f1b_fwd_bwd",
     "make_pipeline_trunk",
     "pipelined_vit_apply",
     "make_pipelined_apply_fn",

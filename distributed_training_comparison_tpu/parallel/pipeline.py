@@ -1,10 +1,13 @@
-"""GPipe-style pipeline parallelism over stacked homogeneous layers.
+"""Pipeline parallelism (GPipe and 1F1B) over stacked homogeneous layers.
 
 The reference has no pipeline parallelism (SURVEY.md §2.2 — absent).  This
 module completes the framework's parallelism axes (data / tensor /
 sequence / pipeline) for the transformer family, whose scanned trunk
 already stores its ``depth`` identical blocks as one stacked pytree
 ``(depth, ...)`` — the natural thing to shard across pipeline stages.
+Two schedules share the stage layout: GPipe (autodiff backward, simplest)
+and 1F1B (hand-scheduled backward, O(P) instead of O(M) stashed
+microbatches — see the 1F1B section below).
 
 Design (TPU-first):
 
@@ -186,6 +189,259 @@ def vit_stage_fn(
         return x
 
     return stage
+
+
+# --------------------------------------------------------------------- 1F1B
+#
+# GPipe above leans on autodiff: the unrolled forward schedule is plain
+# differentiable code, so jax.grad emits the reversed pipeline — but that
+# means EVERY microbatch's stage activations are live between the forward
+# and backward passes: O(M) stashed microbatches per stage.  The 1F1B
+# (one-forward-one-backward / PipeDream-flush) schedule interleaves each
+# microbatch's backward as soon as the last stage has consumed it, so a
+# stage only ever holds the microbatches currently in flight:
+# O(P) — the schedule's steady state alternates one forward and one
+# backward per tick.  Wall-clock bubble is the same (P-1)/(M+P-1) as
+# GPipe; the win is peak activation memory, which is what actually caps M
+# (and therefore how far the bubble can be amortized).
+#
+# SPMD shape: every stage runs the same unrolled program; per-stage
+# behavior (which microbatch, valid or garbage) is selected by traced
+# ``axis_index`` arithmetic, exactly like the GPipe loop above.  The one
+# SPMD-specific twist: at a given tick, different stages need the stage
+# *input* they saw at different past ticks (stage s backs up microbatch
+# ``t - (2P-2-s)``), so inputs are stashed in an O(P)-deep rolling buffer
+# indexed ``microbatch % depth`` (traced), and the stage forward is
+# recomputed under ``jax.vjp`` at backward time — i.e. activation
+# recomputation, the standard Megatron-style trade.  FLOP cost matches
+# GPipe-with---remat; stash drops from O(M) to O(2P) microbatch inputs.
+
+
+def _one_f_one_b(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    head_loss_fn: Callable[[Any, jnp.ndarray, jnp.ndarray], tuple],
+    local_params: Any,
+    head_params: Any,
+    microbatches: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    axis_name: str,
+    data_axis: str | None,
+):
+    """The 1F1B schedule body; call inside ``shard_map``.
+
+    ``microbatches``: ``(M, mb, ...)`` trunk inputs (post-embed tokens),
+    replicated over the pipe axis, batch-sharded over ``data_axis``.
+    ``labels``: ``(M, mb)``.  ``head_loss_fn(head_params, y, labels) ->
+    (scaled_loss_sum, logits)`` is differentiated on the last stage the
+    moment it finishes a microbatch's forward — its ``dy`` cotangent enters
+    the backward pipeline in the same tick.
+
+    Returns ``(loss, trunk_grads_local, head_grads, dtokens, logits)``,
+    already psum'd over the data axis where the quantity is batch-reduced.
+    """
+    p_size = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    is_first = idx == 0
+    is_last = idx == p_size - 1
+    fwd_perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+    bwd_perm = [(j, (j - 1) % p_size) for j in range(p_size)]
+    depth = 2 * p_size - 1  # max in-flight microbatches at any stage
+
+    state = jnp.zeros_like(microbatches[0])   # incoming forward activation
+    dstate = jnp.zeros_like(microbatches[0])  # incoming backward cotangent
+    # rolling stash of stage inputs; slot `depth` is the spill slot for
+    # ticks where this stage has no valid forward (garbage never clobbers
+    # a live microbatch)
+    stash = jnp.zeros((depth + 1, *state.shape), state.dtype)
+    loss = jnp.zeros((), jnp.float32)
+    logits_out = None
+    g_trunk = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), local_params
+    )
+    g_head = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), head_params
+    )
+    dtokens = jnp.zeros_like(microbatches)
+
+    for t in range(m + 2 * p_size - 2):
+        in_fwd_phase = t < m + p_size - 1
+        in_bwd_phase = t >= p_size - 1
+        head_dy = None
+
+        if in_fwd_phase:
+            i = t - idx  # this stage's forward microbatch (traced)
+            valid_f = jnp.logical_and(i >= 0, i < m)
+            feed = microbatches[min(t, m - 1)]
+            x_in = jnp.where(is_first, feed, state)
+            y = stage_fn(local_params, x_in)
+            slot = jnp.where(valid_f, i % depth, depth)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, x_in, slot, axis=0
+            )
+            # last stage: loss + its dy cotangent, immediately
+            lbl_i = labels[jnp.clip(i, 0, m - 1)]
+            (mb_loss, h_vjp, mb_logits) = jax.vjp(
+                lambda hp, yy: head_loss_fn(hp, yy, lbl_i),
+                head_params,
+                y,
+                has_aux=True,
+            )
+            dh, head_dy = h_vjp(jnp.ones((), mb_loss.dtype))
+            take = jnp.logical_and(valid_f, is_last)
+            loss = loss + jnp.where(take, mb_loss, 0.0)
+            g_head = jax.tree_util.tree_map(
+                lambda g, dg: g + jnp.where(take, dg, jnp.zeros_like(dg)),
+                g_head,
+                dh,
+            )
+            if logits_out is None:
+                logits_out = jnp.zeros((m, *mb_logits.shape), mb_logits.dtype)
+            prev = jax.lax.dynamic_index_in_dim(
+                logits_out, jnp.clip(i, 0, m - 1), axis=0, keepdims=False
+            )
+            logits_out = jax.lax.dynamic_update_index_in_dim(
+                logits_out,
+                jnp.where(take, mb_logits, prev),
+                jnp.clip(i, 0, m - 1),
+                axis=0,
+            )
+
+        if in_bwd_phase:
+            j = t - (2 * p_size - 2) + idx  # backward microbatch (traced)
+            valid_b = jnp.logical_and(j >= 0, j < m)
+            x_back = jax.lax.dynamic_index_in_dim(
+                stash, jnp.clip(j, 0, m - 1) % depth, axis=0, keepdims=False
+            )
+            if head_dy is None:
+                head_dy = jnp.zeros_like(dstate)
+            dy = jnp.where(is_last, head_dy.astype(dstate.dtype), dstate)
+            # recompute this stage's forward and pull the cotangent back
+            _, s_vjp = jax.vjp(stage_fn, local_params, x_back)
+            dp, dx = s_vjp(dy)
+            g_trunk = jax.tree_util.tree_map(
+                lambda g, dg: g
+                + jnp.where(valid_b, dg, jnp.zeros_like(dg)).astype(g.dtype),
+                g_trunk,
+                dp,
+            )
+            take_dx = jnp.logical_and(valid_b, is_first)
+            jj = jnp.clip(j, 0, m - 1)
+            prev_dt = jax.lax.dynamic_index_in_dim(
+                dtokens, jj, axis=0, keepdims=False
+            )
+            dtokens = jax.lax.dynamic_update_index_in_dim(
+                dtokens,
+                jnp.where(take_dx, dx.astype(dtokens.dtype), prev_dt),
+                jj,
+                axis=0,
+            )
+
+        # hand activations downstream / cotangents upstream for next tick
+        if in_fwd_phase and t + 1 < m + p_size - 1:
+            state = jax.lax.ppermute(y, axis_name, fwd_perm)
+        if in_bwd_phase and t + 1 < m + 2 * p_size - 2:
+            dstate = jax.lax.ppermute(dx, axis_name, bwd_perm)
+
+    # loss / head grads / logits / dtokens live on one stage each —
+    # broadcast over the pipe axis; batch-reduced quantities also reduce
+    # over the data axis (inside shard_map GSPMD does not insert these)
+    loss = jax.lax.psum(loss, axis_name)
+    g_head = jax.lax.psum(g_head, axis_name)
+    dtokens = jax.lax.psum(dtokens, axis_name)
+    logits_out = jax.lax.psum(logits_out, axis_name)
+    if data_axis is not None:
+        loss = jax.lax.psum(loss, data_axis)
+        g_head = jax.lax.psum(g_head, data_axis)
+        g_trunk = jax.lax.psum(g_trunk, data_axis)
+    return loss, g_trunk, g_head, dtokens, logits_out
+
+
+_HEAD_MODS = ("ln_head", "head")
+
+
+def make_1f1b_fwd_bwd(
+    model,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    pipe_axis: str = MODEL_AXIS,
+    data_axis: str | None = DATA_AXIS,
+):
+    """Build the 1F1B forward+backward for a zoo ViT.
+
+    Returns ``fwd_bwd(params, x, labels) -> (loss, logits, grads)`` with
+    ``grads`` shaped like ``params`` and ``loss`` the global-mean CE — a
+    drop-in for the train step's ``value_and_grad`` (``train/step.py``
+    ``fwd_bwd`` hook).  Unlike GPipe (an ``apply_fn`` swap, backward via
+    autodiff), 1F1B must own the whole fwd+bwd: interleaving microbatch
+    i's backward with i+1's forward requires the loss cotangent *inside*
+    the schedule.  Embed and head still run via the model's own methods on
+    the same parameters (embed under outer autodiff, head inside the
+    schedule on the last stage).
+    """
+    import optax
+
+    stage = vit_stage_fn(model)
+
+    def head_loss(head_params, y, lbl):
+        logits = model.apply({"params": head_params}, y, method="head_out")
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, lbl)
+        return ce.sum(), logits
+
+    def fwd_bwd(params, x, labels):
+        b = labels.shape[0]
+        mth = num_microbatches
+        if b % mth:
+            raise ValueError(f"batch {b} not divisible by microbatches {mth}")
+        scale = 1.0 / b
+
+        def scaled_head_loss(hp, y, lbl):
+            loss_sum, logits = head_loss(hp, y, lbl)
+            return loss_sum * scale, logits
+
+        tokens, embed_vjp = jax.vjp(
+            lambda p: model.apply({"params": p}, x, method="embed"), params
+        )
+        mb = tokens.reshape(mth, b // mth, *tokens.shape[1:])
+        lb = labels.reshape(mth, b // mth)
+        # everything but the trunk: head_out only touches ln_head/head, but
+        # ViT.setup eagerly binds pos_emb via self.param, so the in-schedule
+        # apply needs the (tiny) embed params present too; their gradients
+        # from this vjp are zero and discarded (embed grads come from the
+        # outer embed_vjp)
+        head_params = {k: v for k, v in params.items() if k != "blocks"}
+
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(pipe_axis), params["blocks"]
+        )
+        head_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
+        mb_spec = P(None, data_axis, *([None] * (mb.ndim - 2)))
+        lb_spec = P(None, data_axis)
+        logits_spec = P(None, data_axis, None)
+        loss_v, g_trunk, g_head, dtok, logits = shard_map(
+            partial(
+                _one_f_one_b,
+                stage,
+                scaled_head_loss,
+                axis_name=pipe_axis,
+                data_axis=data_axis,
+            ),
+            mesh=mesh,
+            in_specs=(param_specs, head_specs, mb_spec, lb_spec),
+            out_specs=(P(), param_specs, head_specs, mb_spec, logits_spec),
+            check_vma=False,
+        )(params["blocks"], head_params, mb, lb)
+
+        dtokens = dtok.reshape(b, *tokens.shape[1:])
+        grads = dict(embed_vjp(dtokens)[0])  # embed grads; zeros elsewhere
+        grads["blocks"] = g_trunk
+        for k in _HEAD_MODS:
+            grads[k] = g_head[k]
+        return loss_v, logits.reshape(b, *logits.shape[2:]), grads
+
+    return fwd_bwd
 
 
 def pipelined_vit_apply(

@@ -62,6 +62,7 @@ def _make_step_core(
     std,
     grad_accum: int = 1,
     accum_sharding=None,
+    fwd_bwd=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """The shared train core: augment → normalize → fwd/bwd → SGD update.
 
@@ -76,6 +77,13 @@ def _make_step_core(
     exact (mean of micro-grads == grad of mean loss); BatchNorm statistics
     are computed per micro-batch (the same semantics torch DDP has without
     cross-accumulation SyncBN).
+
+    ``fwd_bwd`` — optional ``(params, x, labels) -> (loss, logits, grads)``
+    replacing the ``value_and_grad`` step for schedules that must own their
+    own backward (the 1F1B pipeline, ``parallel/pipeline.py``); the
+    augmentation/normalization prologue and the optimizer epilogue are
+    shared either way.  Only BN-free models are eligible (the hook carries
+    no batch-stats plumbing).
     """
     compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
@@ -83,6 +91,11 @@ def _make_step_core(
         if augment:
             images = random_crop_flip(images, key)
         x = normalize_images(images, mean, std, dtype=compute_dtype)
+
+        if fwd_bwd is not None:
+            loss, logits, grads = fwd_bwd(params, x, labels)
+            top1, _ = _topk_hits(logits, labels)
+            return grads, batch_stats, loss, top1.sum()
 
         def loss_fn(p):
             logits, mutated = apply_fn(
@@ -165,6 +178,7 @@ def make_train_step(
     std=CIFAR100_STD,
     state_sharding=None,
     grad_accum: int = 1,
+    fwd_bwd=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """Build the compiled ``(state, images_u8, labels, key) -> (state, metrics)``.
 
@@ -180,7 +194,7 @@ def make_train_step(
     accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard)
+    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
 
     # No buffer donation: the AsyncCheckpointer may still be fetching the
     # previous state while the next step runs (see async_ckpt.py); the cost
@@ -288,6 +302,7 @@ def make_chunk_runner(
     std=CIFAR100_STD,
     state_sharding=None,
     grad_accum: int = 1,
+    fwd_bwd=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """K loader steps as ONE compiled ``lax.scan`` dispatch (host streaming).
 
@@ -306,7 +321,7 @@ def make_chunk_runner(
     chunk_shard = batch_sharding(mesh, axis=1)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum, chunk_shard)
+    core = _make_step_core(precision, augment, mean, std, grad_accum, chunk_shard, fwd_bwd)
 
     def run(state: TrainState, images, labels, epoch_key: jax.Array, start):
         def body(state, inp):
@@ -334,6 +349,7 @@ def make_epoch_runner(
     std=CIFAR100_STD,
     state_sharding=None,
     grad_accum: int = 1,
+    fwd_bwd=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -347,7 +363,7 @@ def make_epoch_runner(
     accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard)
+    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
 
     def run(state: TrainState, images, labels, key: jax.Array, epoch):
         n = images.shape[0]

@@ -194,8 +194,10 @@ class Trainer:
                     f"({self.model.depth}) divisible by the model-parallel "
                     f"mesh axis ({mp_size}) to form equal stages"
                 )
+        self.train_fwd_bwd = None  # 1F1B replaces value_and_grad when set
         if style == "pipeline" and mp_size > 1:
             from ..parallel.pipeline import (
+                make_1f1b_fwd_bwd,
                 make_pipelined_apply_fn,
                 pp_state_shardings,
             )
@@ -208,11 +210,17 @@ class Trainer:
                     f"pipeline microbatches ({micro}) x data-parallel size "
                     f"({n_data}); adjust --batch-size/--pipeline-microbatches"
                 )
+            # eval always runs the (forward-only) GPipe schedule; the
+            # train-time backward is picked by --pipeline-schedule
             state = state.replace(
                 apply_fn=make_pipelined_apply_fn(
                     self.model, self.mesh, num_microbatches=micro
                 )
             )
+            if getattr(hparams, "pipeline_schedule", "gpipe") == "1f1b":
+                self.train_fwd_bwd = make_1f1b_fwd_bwd(
+                    self.model, self.mesh, num_microbatches=micro
+                )
             self.state_sharding = pp_state_shardings(self.mesh, state)
         elif style.startswith("sequence") and mp_size > 1:
             from ..parallel.ring import make_sequence_apply_fn
@@ -246,6 +254,7 @@ class Trainer:
                 precision=self.precision,
                 state_sharding=self.state_sharding,
                 grad_accum=self.grad_accum,
+                fwd_bwd=self.train_fwd_bwd,
             )
             self.chunk_runner = None
         else:
@@ -255,6 +264,7 @@ class Trainer:
                 precision=self.precision,
                 state_sharding=self.state_sharding,
                 grad_accum=self.grad_accum,
+                fwd_bwd=self.train_fwd_bwd,
             )
         # whole-split scanned eval: one dispatch per validate()/test() call
         # (one executable per split shape), matching the train path's
