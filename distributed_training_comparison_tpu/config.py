@@ -138,6 +138,23 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "(0 = auto: 4x the stage count; bubble fraction (P-1)/(M+P-1))",
     )
     parser.add_argument(
+        "--patch-size",
+        type=int,
+        default=0,
+        help="ViT patch size override (0 = model default, e.g. 4). "
+        "patch 2 at 32px quadruples the token count to 256 — the "
+        "long-sequence regime on CIFAR inputs",
+    )
+    parser.add_argument(
+        "--scan-unroll",
+        type=int,
+        default=0,
+        help="ViT trunk lax.scan unroll factor: 0 = auto (full unroll on "
+        "TPU, scanned elsewhere), -1 = full, N = unroll N blocks per scan "
+        "iteration. Full unroll removes the scanned loop's per-layer "
+        "residual stacking (measured ~1.9x on vit_tiny/bs256/bf16)",
+    )
+    parser.add_argument(
         "--pipeline-schedule",
         type=str,
         default="gpipe",

@@ -17,7 +17,7 @@ from .resnet import (
     ResNet101,
     ResNet152,
 )
-from .vit import ViT, ViTBlock, ViTSmall, ViTTiny
+from .vit import ViT, ViTBlock, ViTLong, ViTSmall, ViTTiny
 
 _ZOO = {
     "resnet18": ResNet18,
@@ -27,6 +27,7 @@ _ZOO = {
     "resnet152": ResNet152,
     "vit_tiny": ViTTiny,
     "vit_small": ViTSmall,
+    "vit_long": ViTLong,
 }
 
 
@@ -52,5 +53,6 @@ __all__ = [
     "ViTBlock",
     "ViTTiny",
     "ViTSmall",
+    "ViTLong",
     "get_model",
 ]

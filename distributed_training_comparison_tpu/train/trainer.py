@@ -112,6 +112,16 @@ class Trainer:
             # the ViT sizes its position embedding in setup(); the ResNet
             # family is resolution-agnostic and takes no such field
             model_kw["image_size"] = getattr(hparams, "image_size", 32)
+            if getattr(hparams, "patch_size", 0):
+                model_kw["patch"] = hparams.patch_size
+            # trunk unroll: 0 = auto (full unroll on TPU — measured 1.9x
+            # on vit_tiny by eliminating the scanned loop's per-layer
+            # residual stacking; scan elsewhere for compile-time economy).
+            # -1 = full unroll (ViT maps non-positive to its depth).
+            unroll = getattr(hparams, "scan_unroll", 0)
+            if unroll == 0:
+                unroll = -1 if jax.default_backend() == "tpu" else 1
+            model_kw["scan_unroll"] = unroll
         self.model = model if model is not None else get_model(
             hparams.model, **model_kw
         )

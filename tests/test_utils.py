@@ -86,3 +86,27 @@ class TestAccuracy:
         logits = jnp.ones((8, 10))
         labels = jnp.zeros((8,), dtype=jnp.int32)
         assert f(logits, labels).shape == ()
+
+
+def test_collective_census_parser():
+    """The HLO census must count collectives once (-start/-done pairs are
+    one op) and size payloads from result shapes, tuples included."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent))
+    from tools.collective_census import census_from_hlo
+
+    hlo = """
+  %all-reduce.1 = f32[12,192]{1,0} all-reduce(f32[12,192]{1,0} %p), replica_groups={}
+  %ag = bf16[4,64,128]{2,1,0} all-gather(bf16[4,32,128]{2,1,0} %x), dimensions={1}
+  %cp-start = (bf16[2,8]{1,0}, bf16[2,8]{1,0}) collective-permute-start(bf16[2,8]{1,0} %y)
+  %cp-done = bf16[2,8]{1,0} collective-permute-done(%cp-start)
+  %add.5 = f32[2]{0} add(f32[2]{0} %a, f32[2]{0} %b)
+"""
+    c = census_from_hlo(hlo)
+    assert c["all-reduce"] == (1, 12 * 192 * 4)
+    assert c["all-gather"] == (1, 4 * 64 * 128 * 2)
+    # -start counted once; tuple result = 2 * (2*8) bf16
+    assert c["collective-permute"] == (1, 2 * 2 * 8 * 2)
+    assert "add" not in c and len(c) == 3

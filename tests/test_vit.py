@@ -58,6 +58,24 @@ def test_vit_rejects_indivisible_heads():
         m.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3), jnp.float32), train=False)
 
 
+def test_scan_unroll_preserves_forward():
+    """Unrolling the trunk scan (the TPU-default fast path) is a pure
+    scheduling change: identical params structure, identical logits."""
+    kw = dict(depth=4, dim=32, heads=2, patch=8)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3), jnp.float32)
+    base = ViT(**kw)
+    v = base.init(jax.random.key(0), x, train=False)
+    out = base.apply(v, x, train=False)
+    for unroll in (-1, 2):
+        m = ViT(scan_unroll=unroll, **kw)
+        assert jax.tree_util.tree_structure(
+            m.init(jax.random.key(0), x, train=False)
+        ) == jax.tree_util.tree_structure(v)
+        np.testing.assert_allclose(
+            np.asarray(m.apply(v, x, train=False)), np.asarray(out), atol=1e-6
+        )
+
+
 @pytest.mark.slow
 def test_bf16_policy_keeps_params_and_logits_fp32():
     m = models.get_model("vit_tiny", dtype=jnp.bfloat16)

@@ -1,0 +1,191 @@
+"""Per-parallelism-style collective census from compiled HLO.
+
+The environment has one physical chip, so multi-chip communication cost
+cannot be *timed* here — but it can be *counted*: compile one training
+step per parallelism style on a virtual 8-device mesh and tally the
+collectives XLA inserted (kind, count, and payload bytes from the result
+shapes).  This is the honest stand-in for multi-chip perf measurement:
+payload volume per step is topology-independent, and on real hardware it
+divides by ICI bandwidth to give the communication floor.
+
+Usage::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/collective_census.py [--markdown]
+
+Styles covered (same ViT, same global batch, so rows are comparable):
+
+- dp        — (8, 1) mesh, pure data parallelism
+- tp        — (2, 4) mesh, Megatron tensor parallelism on the trunk
+- pp-gpipe  — (2, 4) mesh, GPipe microbatch pipeline (autodiff backward)
+- pp-1f1b   — (2, 4) mesh, 1F1B schedule (hand-scheduled backward)
+- sp-ring   — (2, 4) mesh, ring-attention sequence parallelism
+- sp-ulysses— (2, 4) mesh, Ulysses all-to-all sequence parallelism
+
+The reference repo's only collective story is NCCL all-reduce + a
+per-step barrier (`/root/reference/src/ddp/trainer.py:31,156`); this tool
+exists because the rebuilt framework has four more axes to account for.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from collections import defaultdict
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2,
+    "s64": 8, "s32": 4, "s16": 2, "s8": 1,
+    "u64": 8, "u32": 4, "u16": 2, "u8": 1, "pred": 1,
+}
+
+_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _shape_bytes(shape_str: str) -> int:
+    """Bytes of one HLO result type, e.g. ``f32[12,192]`` or a tuple."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def census_from_hlo(hlo: str) -> dict[str, tuple[int, int]]:
+    """{collective kind: (count, payload bytes)} from compiled HLO text.
+
+    Counts ``-start`` forms only once (the matching ``-done`` carries no
+    separate payload); bytes come from the op's result shape.
+    """
+    out: dict[str, list[int]] = defaultdict(lambda: [0, 0])
+    for line in hlo.splitlines():
+        line = line.strip()
+        m = re.match(r"%?[\w.\-]+ = (.+?) ([\w\-]+)\(", line)
+        if not m:
+            continue
+        shape_str, op = m.groups()
+        kind = op.removesuffix("-start")
+        if kind in _COLLECTIVES and not op.endswith("-done"):
+            out[kind][0] += 1
+            out[kind][1] += _shape_bytes(shape_str)
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def _build_step(style: str):
+    """One compiled train step for ``style``, mirroring the Trainer's own
+    construction (train/trainer.py parallel-style branch)."""
+    from distributed_training_comparison_tpu import parallel
+    from distributed_training_comparison_tpu.models import ViT
+    from distributed_training_comparison_tpu.train import (
+        configure_optimizers,
+        create_train_state,
+        make_train_step,
+    )
+
+    class HP:
+        lr = 0.1
+        weight_decay = 1e-4
+        lr_decay_step_size = 25
+        lr_decay_gamma = 0.1
+
+    model = ViT(depth=8, dim=128, heads=4, patch=4)
+    mp = 1 if style == "dp" else 4
+    mesh = parallel.make_mesh(8, mp, backend="tpu")
+    tx, _ = configure_optimizers(HP, steps_per_epoch=10)
+    state = create_train_state(model, jax.random.key(0), tx)
+    fwd_bwd = None
+
+    if style == "tp":
+        sharding = parallel.state_shardings(mesh, state)
+    elif style.startswith("pp"):
+        state = state.replace(
+            apply_fn=parallel.make_pipelined_apply_fn(
+                model, mesh, num_microbatches=4
+            )
+        )
+        if style == "pp-1f1b":
+            fwd_bwd = parallel.make_1f1b_fwd_bwd(model, mesh, num_microbatches=4)
+        sharding = parallel.pp_state_shardings(mesh, state)
+    elif style.startswith("sp"):
+        impl = "ulysses" if style == "sp-ulysses" else "ring"
+        state = state.replace(
+            apply_fn=parallel.make_sequence_apply_fn(model, mesh, seq_impl=impl)
+        )
+        sharding = jax.tree_util.tree_map(
+            lambda _: parallel.replicated_sharding(mesh), state
+        )
+    else:  # dp
+        sharding = parallel.state_shardings(mesh, state)
+
+    state = parallel.place_tree(state, sharding)
+    step = make_train_step(
+        mesh, precision="bf16", state_sharding=sharding, fwd_bwd=fwd_bwd
+    )
+    batch = 32
+    images, labels = parallel.shard_batch(
+        (np.zeros((batch, 32, 32, 3), np.uint8), np.zeros((batch,), np.int32)),
+        mesh,
+    )
+    return step.lower(state, images, labels, jax.random.key(1)).compile()
+
+
+STYLES = ("dp", "tp", "pp-gpipe", "pp-1f1b", "sp-ring", "sp-ulysses")
+
+
+def main() -> None:
+    markdown = "--markdown" in sys.argv
+    rows = []
+    for style in STYLES:
+        compiled = _build_step(style)
+        hlo = compiled.as_text()
+        census = census_from_hlo(hlo)
+        total_n = sum(c for c, _ in census.values())
+        total_b = sum(b for _, b in census.values())
+        detail = ", ".join(
+            f"{k}×{c} ({b / 2**20:.2f} MiB)"
+            for k, (c, b) in sorted(census.items())
+        ) or "—"
+        rows.append((style, total_n, total_b, detail))
+
+    if markdown:
+        print("| style | collectives/step | payload/step | breakdown |")
+        print("|---|---|---|---|")
+        for style, n, b, detail in rows:
+            print(f"| {style} | {n} | {b / 2**20:.2f} MiB | {detail} |")
+    else:
+        print(f"{'style':<12} {'ops':>4} {'payload':>12}  breakdown")
+        for style, n, b, detail in rows:
+            print(f"{style:<12} {n:>4} {b / 2**20:>9.2f} MiB  {detail}")
+
+
+if __name__ == "__main__":
+    main()
