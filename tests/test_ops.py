@@ -44,6 +44,13 @@ def _rand_qkv(seed, sq, skv, d, dtype=jnp.float32, b=2, h=3):
         pytest.param(False, 64, 500, 128, marks=pytest.mark.slow),   # both lengths padded, full-width head
         (True, 256, 256, 64),
         pytest.param(True, 200, 200, 48, marks=pytest.mark.slow),
+        # multi-tile backward: padded 1024 > the 512 streamed tile, so the
+        # causal diagonal gate, lo-based accumulator init, and cross-step
+        # scratch accumulation actually execute (single-tile cases leave
+        # them dead)
+        pytest.param(True, 1024, 1024, 64, marks=pytest.mark.slow),
+        pytest.param(True, 1000, 1000, 64, marks=pytest.mark.slow),
+        pytest.param(False, 640, 1152, 64, marks=pytest.mark.slow),
     ],
 )
 def test_flash_matches_reference(causal, sq, skv, d):
