@@ -37,3 +37,27 @@ def test_train_is_three_forwards():
         3 * forward_flops_per_image("resnet50", image_size=224, stem="imagenet"),
         rel=1e-9,
     )
+
+
+def test_run_legs_isolates_leg_failures(monkeypatch):
+    """One leg blowing up (the round-3 failure mode: a compile OOM) must
+    record an error for that leg only — every other leg's numbers survive."""
+    import bench
+
+    def fake_bench_native(mesh, images, labels, model_name, *a, **kw):
+        if model_name == "resnet50":
+            raise RuntimeError("Mosaic scoped vmem OOM (simulated)")
+        return 1000.0
+
+    monkeypatch.setattr(bench, "bench_native", fake_bench_native)
+    configs = [
+        ("leg_ok", "resnet18", "bf16", 64, 32, "cifar", 128, 1, {}),
+        ("leg_boom", "resnet50", "bf16", 64, 32, "cifar", 128, 1, {}),
+        ("leg_vit", "vit_tiny", "bf16", 64, 32, "cifar", 128, 1, {}),
+    ]
+    per_config, ref_data = bench.run_legs(None, configs, 1, 197e12)
+    assert per_config["leg_ok"]["images_per_sec_per_chip"] == 1000.0
+    assert "vmem OOM" in per_config["leg_boom"]["error"]
+    # tokens/s derived for transformer legs (64 tokens at 32px / patch 4)
+    assert per_config["leg_vit"]["tokens_per_sec_per_chip"] == 64_000
+    assert ref_data is not None
