@@ -152,16 +152,22 @@ def _best_sort_key(path: Path) -> tuple[int, float]:
         return (-1, -1.0)
 
 
-def find_best_checkpoint(version_dir: str | Path, cleanup: bool = True) -> Path | None:
+def find_best_checkpoint(version_dir: str | Path, cleanup: bool = False) -> Path | None:
     """Glob the best file like the reference's test phase
     (``src/single/main.py:23-27``) — but pick by numeric epoch (highest-acc
     tiebreak), not string order.
 
     Two best files can coexist in the crash window of ``save_checkpoint``
     (new file written before old ones are unlinked); ``cleanup=True``
-    restores the one-best invariant by dropping the stale losers.  Only
-    files this module's own naming scheme accounts for are ever deleted —
-    a user's stray ``best_model_backup.ckpt`` is not ours to unlink."""
+    restores the one-best invariant by dropping the stale losers.  It is
+    opt-in: a lookup must not mutate the version dir by default —
+    concurrent readers (multi-host processes, external monitors, a test
+    phase against a live training dir) could race the unlinks (advisor
+    r3).  The steady-state invariant holder is ``save_checkpoint``, which
+    unlinks superseded bests after each durable write.  When cleanup does
+    run, only files this module's own naming scheme accounts for are ever
+    deleted — a user's stray ``best_model_backup.ckpt`` is not ours to
+    unlink."""
     hits = sorted(Path(version_dir).glob(f"{BEST_PREFIX}*.ckpt"), key=_best_sort_key)
     if not hits:
         return None

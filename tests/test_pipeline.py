@@ -237,6 +237,26 @@ def test_trainer_pipeline_rejects_resnet(tmp_path):
         Trainer(hp)
 
 
+def test_trainer_pipeline_grad_accum_divisibility(tmp_path):
+    """batch 8 / grad-accum 4 / microbatches 4 over the 2-way data axis
+    leaves a per-micro-update batch of 2 — not splittable into 4×2
+    microbatch shards.  Must fail at Trainer init, not at jit trace time
+    inside the 1F1B fwd_bwd (advisor r3)."""
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "256",
+            "--model", "vit_tiny",
+            "--batch-size", "8", "--grad-accum", "4",
+            "--model-parallel", "4", "--parallel-style", "pipeline",
+            "--pipeline-microbatches", "4",
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(hp)
+
+
 # batch is 8 over a 2-way data axis, so M=4 (one example per microbatch
 # per data shard) is the steady-state case; 1 and 2 exercise M < P
 @pytest.mark.parametrize("microbatches", [1, 2, 4])

