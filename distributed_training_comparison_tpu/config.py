@@ -197,6 +197,14 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Train on generated data (benchmark mode / no dataset on disk)",
     )
     parser.add_argument(
+        "--synthetic-noise",
+        type=float,
+        default=0.15,
+        help="Noise sigma around the per-class anchor images of "
+        "--synthetic-data. Higher = harder task; convergence-parity runs "
+        "raise it so final accuracy lands mid-range instead of saturating",
+    )
+    parser.add_argument(
         "--remat",
         action="store_true",
         default=False,

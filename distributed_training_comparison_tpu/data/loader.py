@@ -66,6 +66,7 @@ def _raw_split(hparams, split: str) -> tuple[np.ndarray, np.ndarray]:
             image_shape=(size, size, 3),
             seed=hparams.seed + (split == "test"),
             anchor_seed=hparams.seed,
+            noise=getattr(hparams, "synthetic_noise", 0.15),
         )
     if getattr(hparams, "image_size", 32) not in (0, 32):
         raise ValueError(

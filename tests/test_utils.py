@@ -98,15 +98,20 @@ def test_collective_census_parser():
     from tools.collective_census import census_from_hlo
 
     hlo = """
-  %all-reduce.1 = f32[12,192]{1,0} all-reduce(f32[12,192]{1,0} %p), replica_groups={}
-  %ag = bf16[4,64,128]{2,1,0} all-gather(bf16[4,32,128]{2,1,0} %x), dimensions={1}
-  %cp-start = (bf16[2,8]{1,0}, bf16[2,8]{1,0}) collective-permute-start(bf16[2,8]{1,0} %y)
+  %all-reduce.1 = f32[12,192]{1,0} all-reduce(f32[12,192]{1,0} %p), replica_groups={{0,1,2,3},{4,5,6,7}}
+  %ag = bf16[4,64,128]{2,1,0} all-gather(bf16[4,32,128]{2,1,0} %x), replica_groups=[4,2]<=[2,4]T(1,0), dimensions={1}
+  %cp-start = (bf16[2,8]{1,0}, bf16[2,8]{1,0}) collective-permute-start(bf16[2,8]{1,0} %y), source_target_pairs={{0,1},{3,4}}
   %cp-done = bf16[2,8]{1,0} collective-permute-done(%cp-start)
   %add.5 = f32[2]{0} add(f32[2]{0} %a, f32[2]{0} %b)
 """
-    c = census_from_hlo(hlo)
-    assert c["all-reduce"] == (1, 12 * 192 * 4)
-    assert c["all-gather"] == (1, 4 * 64 * 128 * 2)
-    # -start counted once; tuple result = 2 * (2*8) bf16
-    assert c["collective-permute"] == (1, 2 * 2 * 8 * 2)
+    c = census_from_hlo(hlo)  # host_size=4: devices 0-3 host A, 4-7 host B
+    # explicit groups confined to one host each → no DCN share
+    assert c["all-reduce"] == (1, 12 * 192 * 4, 0)
+    # transposed-iota groups {0,4},{1,5},... all span hosts → full payload
+    ag_bytes = 4 * 64 * 128 * 2
+    assert c["all-gather"] == (1, ag_bytes, ag_bytes)
+    # -start counted once; tuple result = 2 * (2*8) bf16; one of the two
+    # point-to-point pairs (3→4) crosses hosts → half the payload
+    cp_bytes = 2 * 2 * 8 * 2
+    assert c["collective-permute"] == (1, cp_bytes, cp_bytes // 2)
     assert "add" not in c and len(c) == 3

@@ -80,13 +80,72 @@ def _shape_bytes(shape_str: str) -> int:
     return total
 
 
-def census_from_hlo(hlo: str) -> dict[str, tuple[int, int]]:
-    """{collective kind: (count, payload bytes)} from compiled HLO text.
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{\{([\d,{} ]+)\}\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=\{\{([\d,{} ]+)\}\}")
+
+
+def _replica_groups(line: str) -> list[list[int]] | None:
+    """Parse an HLO collective's replica groups.  Three syntaxes appear in
+    compiled text: explicit ``{{0,1},{2,3}}``, iota ``[2,4]<=[8]``, and
+    transposed iota ``[4,2]<=[2,4]T(1,0)``."""
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        return [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in m.group(1).split("},{")
+        ]
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        ng, gs = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            arr = arr.transpose([int(x) for x in m.group(4).split(",")])
+        return arr.reshape(ng, gs).tolist()
+    m = _PAIRS_RE.search(line)
+    if m:  # collective-permute: each {src,dst} pair is its own "group"
+        return [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in m.group(1).split("},{")
+        ]
+    return None
+
+
+def _dcn_fraction(groups: list[list[int]] | None, host_size: int, kind: str) -> float:
+    """Fraction of a collective's payload that leaves a host of
+    ``host_size`` chips.  A group confined to one host rides ICI; a group
+    spanning hosts rides DCN in a real multi-host topology
+    (parallel/dist.py).  Ring/tree collectives (all-reduce & co) pay DCN
+    for the whole payload once any group spans hosts; a collective-permute
+    is independent point-to-point pairs, so only the crossing pairs' share
+    counts."""
+    if not groups:
+        return 1.0  # unattributed collective: assume worst case
+    if kind == "collective-permute":
+        crossing = sum(
+            1 for g in groups if len({d // host_size for d in g}) > 1
+        )
+        return crossing / len(groups)
+    return float(
+        any(len({d // host_size for d in g}) > 1 for g in groups)
+    )
+
+
+def census_from_hlo(hlo: str, host_size: int = 4) -> dict[str, tuple[int, int, int]]:
+    """{collective kind: (count, payload bytes, DCN-crossing bytes)} from
+    compiled HLO text.
 
     Counts ``-start`` forms only once (the matching ``-done`` carries no
-    separate payload); bytes come from the op's result shape.
+    separate payload); bytes come from the op's result shape.  The third
+    field models the 8 virtual devices as 2 hosts x ``host_size`` chips
+    and attributes a collective's payload to DCN when any of its replica
+    groups spans the host boundary — the number that divides by DCN (not
+    ICI) bandwidth in a real 2-host run.
     """
-    out: dict[str, list[int]] = defaultdict(lambda: [0, 0])
+    out: dict[str, list[int]] = defaultdict(lambda: [0, 0, 0])
     for line in hlo.splitlines():
         line = line.strip()
         m = re.match(r"%?[\w.\-]+ = (.+?) ([\w\-]+)\(", line)
@@ -95,9 +154,13 @@ def census_from_hlo(hlo: str) -> dict[str, tuple[int, int]]:
         shape_str, op = m.groups()
         kind = op.removesuffix("-start")
         if kind in _COLLECTIVES and not op.endswith("-done"):
+            nbytes = _shape_bytes(shape_str)
             out[kind][0] += 1
-            out[kind][1] += _shape_bytes(shape_str)
-    return {k: (v[0], v[1]) for k, v in out.items()}
+            out[kind][1] += nbytes
+            out[kind][2] += int(
+                nbytes * _dcn_fraction(_replica_groups(line), host_size, kind)
+            )
+    return {k: tuple(v) for k, v in out.items()}
 
 
 def _build_step(style: str):
@@ -118,13 +181,14 @@ def _build_step(style: str):
         lr_decay_gamma = 0.1
 
     model = ViT(depth=8, dim=128, heads=4, patch=4)
-    mp = 1 if style == "dp" else 4
+    mp = {"dp": 1, "dp4-tp2": 2}.get(style, 4)
     mesh = parallel.make_mesh(8, mp, backend="tpu")
     tx, _ = configure_optimizers(HP, steps_per_epoch=10)
     state = create_train_state(model, jax.random.key(0), tx)
     fwd_bwd = None
+    grad_accum = 2 if style.endswith("accum2") else 1
 
-    if style == "tp":
+    if style in ("tp", "dp4-tp2"):
         sharding = parallel.state_shardings(mesh, state)
     elif style.startswith("pp"):
         state = state.replace(
@@ -132,7 +196,7 @@ def _build_step(style: str):
                 model, mesh, num_microbatches=4
             )
         )
-        if style == "pp-1f1b":
+        if style.startswith("pp-1f1b"):
             fwd_bwd = parallel.make_1f1b_fwd_bwd(model, mesh, num_microbatches=4)
         sharding = parallel.pp_state_shardings(mesh, state)
     elif style.startswith("sp"):
@@ -148,7 +212,8 @@ def _build_step(style: str):
 
     state = parallel.place_tree(state, sharding)
     step = make_train_step(
-        mesh, precision="bf16", state_sharding=sharding, fwd_bwd=fwd_bwd
+        mesh, precision="bf16", state_sharding=sharding, fwd_bwd=fwd_bwd,
+        grad_accum=grad_accum,
     )
     batch = 32
     images, labels = parallel.shard_batch(
@@ -158,7 +223,16 @@ def _build_step(style: str):
     return step.lower(state, images, labels, jax.random.key(1)).compile()
 
 
-STYLES = ("dp", "tp", "pp-gpipe", "pp-1f1b", "sp-ring", "sp-ulysses")
+STYLES = (
+    "dp",
+    "tp",
+    "dp4-tp2",          # DP x TP composition (4-way data x 2-way tensor)
+    "pp-gpipe",
+    "pp-1f1b",
+    "pp-1f1b-accum2",   # PP composed with --grad-accum 2
+    "sp-ring",
+    "sp-ulysses",
+)
 
 
 def main() -> None:
@@ -168,23 +242,32 @@ def main() -> None:
         compiled = _build_step(style)
         hlo = compiled.as_text()
         census = census_from_hlo(hlo)
-        total_n = sum(c for c, _ in census.values())
-        total_b = sum(b for _, b in census.values())
+        total_n = sum(c for c, _, _ in census.values())
+        total_b = sum(b for _, b, _ in census.values())
+        dcn_b = sum(d for _, _, d in census.values())
         detail = ", ".join(
             f"{k}×{c} ({b / 2**20:.2f} MiB)"
-            for k, (c, b) in sorted(census.items())
+            for k, (c, b, _) in sorted(census.items())
         ) or "—"
-        rows.append((style, total_n, total_b, detail))
+        rows.append((style, total_n, total_b, dcn_b, detail))
 
+    # the DCN column models the 8 virtual chips as 2 hosts x 4: payload in
+    # groups spanning the host boundary rides DCN in a real 2-host run
     if markdown:
-        print("| style | collectives/step | payload/step | breakdown |")
-        print("|---|---|---|---|")
-        for style, n, b, detail in rows:
-            print(f"| {style} | {n} | {b / 2**20:.2f} MiB | {detail} |")
+        print("| style | collectives/step | payload/step | DCN-crossing (2×4 hosts) | breakdown |")
+        print("|---|---|---|---|---|")
+        for style, n, b, d, detail in rows:
+            print(
+                f"| {style} | {n} | {b / 2**20:.2f} MiB | "
+                f"{d / 2**20:.2f} MiB | {detail} |"
+            )
     else:
-        print(f"{'style':<12} {'ops':>4} {'payload':>12}  breakdown")
-        for style, n, b, detail in rows:
-            print(f"{style:<12} {n:>4} {b / 2**20:>9.2f} MiB  {detail}")
+        print(f"{'style':<16} {'ops':>4} {'payload':>12} {'DCN(2x4)':>12}  breakdown")
+        for style, n, b, d, detail in rows:
+            print(
+                f"{style:<16} {n:>4} {b / 2**20:>9.2f} MiB {d / 2**20:>8.2f} MiB"
+                f"  {detail}"
+            )
 
 
 if __name__ == "__main__":
