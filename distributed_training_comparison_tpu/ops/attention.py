@@ -533,14 +533,14 @@ def attention(
         # cross-attention stays on the reference path
         kernel_ok = not causal or q.shape[seq_ax] == k.shape[seq_ax]
         # Measured fwd+bwd crossover on a v5e chip (bf16, batched so total
-        # tokens are constant): at D=128 the kernel wins from S~1024
-        # (0.88x at 1024, 0.65x at 2048); at D=64 the half-filled MXU lanes
-        # push the crossover to S~2048 (1.40x at 1024, 0.83x at 2048).
-        # Below that, one fused XLA softmax over big batched matmuls beats
-        # the per-(batch, head) kernel grid — the r2 threshold of S>=256
-        # dispatched CIFAR-ViT configs onto the kernel at a measured 1.6x
-        # slowdown.
-        min_seq = 1024 if q.shape[-1] >= 128 else 2048
+        # tokens are constant), re-validated after the round-4 tiled
+        # backward cut bwd time ~17%: at D=128 the kernel wins from S=512
+        # (0.83x at 512, 0.64x at 1024, 0.52x at 2048; 1.6x at 256); at
+        # D=64 the half-filled MXU lanes push the crossover to S=1024
+        # (1.53x at 512, 0.93x/0.88x at 1024, 0.72x at 2048).  Below that,
+        # one fused XLA softmax over big batched matmuls beats the
+        # per-(batch, head) kernel grid.
+        min_seq = 512 if q.shape[-1] >= 128 else 1024
         impl = (
             "pallas"
             if on_tpu and kernel_ok and q.shape[seq_ax] >= min_seq
