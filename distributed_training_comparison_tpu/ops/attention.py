@@ -37,10 +37,14 @@ Kernel design (TPU-first, not a CUDA translation):
   S=4096, D=128, bh=32.  Tiling by grid makes the footprint small and
   static — there is nothing left to predict.)
 
-In the *forward*, whole-sequence K/V live in VMEM per (batch, head)
-instance: 2·S·D·2 bytes — ~4 MB at S=8192, D=128 (bf16), comfortably
-under the ~16 MB/core VMEM budget.  For longer sequences, shard S over
-the mesh with ring attention instead of growing the per-core working set.
+The *forward* has two shapes: up to ~8k keys (D=128, bf16) whole-sequence
+K/V live in VMEM per (batch, head) instance — 2·S·D·2 bytes, loaded once
+and reused across every query block, the bandwidth-optimal layout.  Past
+the ``_FWD_RESIDENT_KV_LIMIT`` footprint the wrapper switches to a fully
+tiled (bh, nq, nk) grid carrying the online-softmax state (acc, running
+max/sum) in fp32 VMEM scratch — K/V re-stream once per query block, and S
+is bounded by HBM, not VMEM.  Beyond one chip's HBM, shard S over the
+mesh with ring attention (``parallel/ring.py``).
 """
 
 from __future__ import annotations
@@ -178,9 +182,116 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, 
     lse_ref[...] = jnp.broadcast_to(m + jnp.log(l_safe), (block_q, 8))
 
 
+# above this resident-K/V footprint (bytes, double-buffered by Mosaic) the
+# forward switches to the fully-tiled kernel: S stops being VMEM-bounded
+_FWD_RESIDENT_KV_LIMIT = 4 * 2**20
+
+
+def _fwd_kernel_tiled(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr,
+    *, scale, causal, kv_len,
+):
+    """One (query block, key block) tile of the forward.  Grid (bh, nq, nk):
+    the innermost dim streams key/value blocks past fp32 VMEM scratch
+    carrying the online-softmax state (acc, running max, running sum); the
+    final key step normalizes and writes the output block.  Unlike
+    ``_fwd_kernel`` nothing whole-sequence is ever VMEM-resident, so S is
+    bounded by HBM, not VMEM."""
+    block_q, d = q_ref.shape
+    block_k = k_ref.shape[0]
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    def compute():
+        s = _scores(q_ref[...], k_ref[...], scale)
+        mask = _block_mask(i, j, block_q, block_k, kv_len, causal)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # defensive zeroing: masked columns stay exactly 0 whatever the
+        # running max is.  In every reachable state bare exp(s - m_new)
+        # already underflows to 0 (tile j=0 always sees a valid key, so
+        # m_new is finite from then on); the where() guards the invariant
+        # against refactors, it is not load-bearing today
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        vb = v_ref[...]
+        acc[...] = acc[...] * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        @pl.when(j * block_k < (i + 1) * block_q)
+        def _():
+            compute()
+    else:
+        compute()
+
+    # the last key step always runs (even when causal-skipped: the scratch
+    # already holds this row block's complete softmax state)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, 0:1], 1e-30)  # padded rows stay finite
+        o_ref[...] = (acc[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(
+            m_scr[:, 0:1] + jnp.log(l_safe), lse_ref.shape
+        )
+
+
+def _flash_fwd_tiled(q3, k3, v3, scale, causal, block_q, kv_len, interpret):
+    bh, sq, d = q3.shape
+    skv = k3.shape[1]
+    bq = _stream_block(sq, max(block_q, 256))
+    bk = _stream_block(skv, 512)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel_tiled, scale=scale, causal=causal, kv_len=kv_len
+        ),
+        grid=(bh, sq // bq, skv // bk),
+        in_specs=[
+            pl.BlockSpec((None, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 8), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 8), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 8), jnp.float32),
+            pltpu.VMEM((bq, 8), jnp.float32),
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+    )(q3, k3, v3)
+    return out, lse
+
+
 def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, kv_len, interpret):
     bh, sq, d = q3.shape
     skv = k3.shape[1]
+    if 2 * skv * d * q3.dtype.itemsize > _FWD_RESIDENT_KV_LIMIT:
+        # resident K/V would crowd VMEM: stream tiles instead (HBM cost:
+        # K/V re-read once per query block — amortized by the q tile size)
+        return _flash_fwd_tiled(q3, k3, v3, scale, causal, block_q, kv_len, interpret)
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, block_k=block_k, kv_len=kv_len
@@ -314,12 +425,17 @@ def _dkv_kernel(
 
 
 def _stream_block(n: int, target: int) -> int:
-    """Largest power-of-two tile ≤ ``target`` that divides ``n`` (which is
-    already padded to a multiple of 128), floored at 128."""
-    b = target
+    """Largest power-of-two tile ≤ ``target`` dividing ``n``, floored at
+    128 — with a gcd fallback because ``n`` is padded to a multiple of the
+    *caller-chosen* forward block, which need not be a multiple of 128
+    (e.g. block_q=64, sq=150 → n=192): a non-divisor tile would make the
+    grid's floor division silently drop the tail block."""
+    b = min(target, n)
     while b > 128 and n % b:
         b //= 2
-    return min(b, n)
+    if n % b:
+        b = math.gcd(n, b)
+    return b
 
 
 def _flash_bwd(q3, k3, v3, out3, lse, do3, dlse, scale, causal, kv_len, interpret):
@@ -447,10 +563,13 @@ def flash_attention(
     interpreter (CI on CPU).
 
     ``block_k=None`` picks the largest of {2048, 1024, 512, 256, 128} that
-    divides the padded key length: the kernel loop over tiny key blocks is
-    MXU-latency-bound (measured on a v5e at S=2048: 19 TF/s with 128-wide
-    key blocks vs 85-105 TF/s with 1-2k-wide), and K/V are whole-sequence
-    VMEM residents anyway, so wide blocks cost nothing extra.
+    divides the padded key length: in the resident-K/V regime the kernel
+    loop over tiny key blocks is MXU-latency-bound (measured on a v5e at
+    S=2048: 19 TF/s with 128-wide key blocks vs 85-105 TF/s with
+    1-2k-wide), and K/V are whole-sequence VMEM residents there, so wide
+    blocks cost nothing extra.  Past ``_FWD_RESIDENT_KV_LIMIT`` the
+    streamed forward takes over and ``block_q``/``block_k`` only pin the
+    padding — the streamed tile sizes are chosen internally (≤512).
     """
     b, h, sq, d = q.shape
     skv = k.shape[2]

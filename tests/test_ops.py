@@ -71,6 +71,89 @@ def test_flash_matches_reference(causal, sq, skv, d):
         assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
 
 
+@pytest.mark.parametrize(
+    "causal,sq,skv,d",
+    [
+        (False, 256, 256, 64),
+        # multi-tile causal: diagonal gate + scratch carry across key steps
+        pytest.param(True, 1024, 1024, 64, marks=pytest.mark.slow),
+        # padded seq + head dim
+        pytest.param(False, 200, 200, 48, marks=pytest.mark.slow),
+        # cross-attention with kv padding
+        pytest.param(False, 640, 1152, 64, marks=pytest.mark.slow),
+    ],
+)
+def test_flash_tiled_forward_matches_reference(monkeypatch, causal, sq, skv, d):
+    """The streamed-K/V forward (selected above _FWD_RESIDENT_KV_LIMIT) is
+    numerically the same kernel contract as the resident-K/V one; force it
+    by zeroing the limit and check outputs + grads against the reference."""
+    import importlib
+
+    A = importlib.import_module(
+        "distributed_training_comparison_tpu.ops.attention"
+    )
+    monkeypatch.setattr(A, "_FWD_RESIDENT_KV_LIMIT", 0)
+    q, k, v, do = _rand_qkv(sq * 3 + d + causal, sq, skv, d)
+    with jax.default_matmul_precision("highest"):
+        out_f, vjp_f = jax.vjp(
+            lambda q, k, v: flash_attention(q, k, v, causal=causal, interpret=True),
+            q, k, v,
+        )
+        out_r, vjp_r = jax.vjp(
+            lambda q, k, v: mha_reference(q, k, v, causal=causal), q, k, v
+        )
+        grads_f, grads_r = vjp_f(do), vjp_r(do)
+    assert float(jnp.max(jnp.abs(out_f - out_r))) < 2e-5
+    for gf, gr, name in zip(grads_f, grads_r, "qkv"):
+        assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
+
+
+def test_flash_tiled_forward_fully_masked_tile(monkeypatch):
+    """Explicit block_k much larger than the true key length pads past a
+    whole 512-wide streamed tile, so a fully-masked stream tile is
+    visited: its contribution must be exactly zero and the online-softmax
+    scratch must carry through it unchanged."""
+    import importlib
+
+    A = importlib.import_module(
+        "distributed_training_comparison_tpu.ops.attention"
+    )
+    monkeypatch.setattr(A, "_FWD_RESIDENT_KV_LIMIT", 0)
+    q, k, v, _ = _rand_qkv(7, 256, 300, 64)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, block_k=1024, interpret=True)
+        base = mha_reference(q, k, v)
+    assert float(jnp.max(jnp.abs(out - base))) < 2e-5
+
+
+def test_flash_non_pow2_padded_length(monkeypatch):
+    """Caller-chosen blocks can pad the sequence to a non-multiple of 128
+    (block_q=64, sq=150 → padded 192).  The streamed tiles must still
+    cover the whole padded length — a non-divisor tile makes the grid's
+    floor division silently drop the tail block (rows beyond it would be
+    garbage in the fwd output and dq, and tail keys would never
+    contribute to dk/dv)."""
+    import importlib
+
+    A = importlib.import_module(
+        "distributed_training_comparison_tpu.ops.attention"
+    )
+    monkeypatch.setattr(A, "_FWD_RESIDENT_KV_LIMIT", 0)  # tiled fwd too
+    q, k, v, do = _rand_qkv(13, 150, 150, 64)
+    with jax.default_matmul_precision("highest"):
+        out_f, vjp_f = jax.vjp(
+            lambda q, k, v: flash_attention(
+                q, k, v, block_q=64, block_k=64, interpret=True
+            ),
+            q, k, v,
+        )
+        out_r, vjp_r = jax.vjp(lambda q, k, v: mha_reference(q, k, v), q, k, v)
+        grads_f, grads_r = vjp_f(do), vjp_r(do)
+    assert float(jnp.max(jnp.abs(out_f - out_r))) < 2e-5
+    for gf, gr, name in zip(grads_f, grads_r, "qkv"):
+        assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
+
+
 def test_flash_explicit_blocks():
     """Non-default block shapes (incl. block_k spanning the whole padded
     sequence, the measured-fastest TPU config) agree with the default."""
