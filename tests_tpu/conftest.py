@@ -1,0 +1,32 @@
+"""On-hardware kernel gate (VERDICT r3 item 4).
+
+``tests/`` pins everything to the virtual 8-device CPU mesh so CI is
+hermetic — which also means CI cannot see Mosaic VMEM limits, real
+tolerances, or compile failures that only exist on the chip (round 3
+shipped exactly such a regression).  This directory is the complement:
+it runs ONLY on a real TPU and is skipped everywhere else.
+
+The commit-time one-liner (~2-4 min warm via the persistent compile
+cache):
+
+    python -m pytest tests_tpu/ -q
+
+Keep it out of ``pytest tests/`` invocations — the driver's CI loop stays
+CPU-hermetic; this gate is for the developer with the chip.
+"""
+
+import jax
+import pytest
+
+from distributed_training_comparison_tpu.utils import (
+    enable_persistent_compilation_cache,
+)
+
+enable_persistent_compilation_cache()
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(reason="requires a real TPU backend")
+        for item in items:
+            item.add_marker(skip)
