@@ -94,7 +94,7 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         default="resnet18",
         choices=[
             "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
-            "vit_tiny", "vit_small",
+            "vit_tiny", "vit_small", "vit_long", "vit_moe",
         ],
         help="Model zoo entry (live, unlike the reference's dead --model flag)",
     )

@@ -17,7 +17,8 @@ from .resnet import (
     ResNet101,
     ResNet152,
 )
-from .vit import ViT, ViTBlock, ViTLong, ViTSmall, ViTTiny
+from .moe import SwitchFFN
+from .vit import ViT, ViTBlock, ViTLong, ViTMoE, ViTSmall, ViTTiny
 
 _ZOO = {
     "resnet18": ResNet18,
@@ -28,6 +29,7 @@ _ZOO = {
     "vit_tiny": ViTTiny,
     "vit_small": ViTSmall,
     "vit_long": ViTLong,
+    "vit_moe": ViTMoE,
 }
 
 
@@ -54,5 +56,7 @@ __all__ = [
     "ViTTiny",
     "ViTSmall",
     "ViTLong",
+    "ViTMoE",
+    "SwitchFFN",
     "get_model",
 ]

@@ -42,7 +42,11 @@ from .norms import norm_policy
 
 
 class ViTBlock(nn.Module):
-    """Pre-LN transformer block, scan-compatible: ``(x, None) -> (x, None)``."""
+    """Pre-LN transformer block, scan-compatible: ``(x, None) -> (x, None)``.
+
+    ``num_experts > 0`` replaces the dense MLP with a Switch-style
+    mixture-of-experts FFN (``models/moe.py``) — the expert axis is what
+    expert parallelism shards (``parallel/tp.py``)."""
 
     dim: int
     heads: int
@@ -50,6 +54,8 @@ class ViTBlock(nn.Module):
     dtype: Any = jnp.float32
     norm_dtype: Any = jnp.float32
     attn_impl: str = "auto"
+    num_experts: int = 0
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, _carry_in=None):
@@ -83,6 +89,18 @@ class ViTBlock(nn.Module):
         x = x + nn.Dense(dim, dtype=self.dtype, kernel_init=xavier, name="proj")(o)
 
         h = norm(name="ln_mlp")(x).astype(self.dtype)
+        if self.num_experts:
+            from .moe import SwitchFFN
+
+            x = x + SwitchFFN(
+                dim=dim,
+                num_experts=self.num_experts,
+                mlp_ratio=self.mlp_ratio,
+                capacity_factor=self.capacity_factor,
+                dtype=self.dtype,
+                name="moe",
+            )(h)
+            return x, None
         h = nn.Dense(
             self.mlp_ratio * dim, dtype=self.dtype, kernel_init=xavier, name="mlp_up"
         )(h)
@@ -106,6 +124,8 @@ class ViT(nn.Module):
     dtype: Any = jnp.float32
     norm_dtype: Any = jnp.float32
     attn_impl: str = "auto"
+    num_experts: int = 0  # > 0: Switch-MoE FFN in every block (models/moe.py)
+    capacity_factor: float = 1.25
     remat: bool = False
     stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
     # lax.scan unroll factor for the trunk (params stay stacked either way,
@@ -142,7 +162,9 @@ class ViT(nn.Module):
             block = nn.remat(block, prevent_cse=False)
         self.blocks = nn.scan(
             block,
-            variable_axes={"params": 0},
+            # "losses": the MoE aux loss sown per block stacks on the depth
+            # axis (a no-op collection for dense blocks)
+            variable_axes={"params": 0, "losses": 0},
             split_rngs={"params": True},
             length=self.depth,
             unroll=self.depth if self.scan_unroll <= 0 else self.scan_unroll,
@@ -154,6 +176,8 @@ class ViT(nn.Module):
             dtype=self.dtype,
             norm_dtype=self.norm_dtype,
             attn_impl=self.attn_impl,
+            num_experts=self.num_experts,
+            capacity_factor=self.capacity_factor,
         )
         self.ln_head = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)()
         self.head = nn.Dense(
@@ -190,6 +214,19 @@ def ViTTiny(**kw) -> ViT:
 
 def ViTSmall(**kw) -> ViT:
     return ViT(depth=12, dim=384, heads=6, **kw)
+
+
+def ViTMoE(**kw) -> ViT:
+    """Switch-MoE config: ViT-Tiny-scale trunk where every block's FFN is
+    8 experts behind a top-1 router — ~4.6× the dense FFN parameters at
+    roughly the dense FLOPs/token (one expert per token + router).  The
+    expert axis shards over ``"model"`` (``--model-parallel N``,
+    expert parallelism); 8 % N == 0 keeps experts whole per shard."""
+    kw.setdefault("num_experts", 8)
+    kw.setdefault("depth", 8)
+    kw.setdefault("dim", 192)
+    kw.setdefault("heads", 3)
+    return ViT(**kw)
 
 
 def ViTLong(**kw) -> ViT:

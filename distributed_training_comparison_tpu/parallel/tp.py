@@ -41,8 +41,10 @@ from .mesh import MODEL_AXIS
 _TP_STAGES = ("stage3_", "stage4_")
 
 # submodules identifying a ViT scanned-trunk param tree (models/vit.py);
-# leaves carry a leading (depth,) stack axis
-_VIT_BLOCK_KEYS = {"q_proj", "k_proj", "v_proj", "proj", "mlp_up", "mlp_down"}
+# leaves carry a leading (depth,) stack axis.  Only the attention
+# projections are required: the FFN may be dense (mlp_up/mlp_down) or a
+# MoE ("moe", models/moe.py)
+_VIT_BLOCK_KEYS = {"q_proj", "k_proj", "v_proj", "proj"}
 
 _REPL = P()
 
@@ -90,6 +92,18 @@ def _vit_trunk_specs(blocks: dict[str, Any]) -> dict[str, Any]:
             specs[name] = col
         elif name in ("proj", "mlp_down"):
             specs[name] = row
+        elif name == "moe":
+            # expert parallelism: the expert axis (axis 1 behind the depth
+            # stack) shards over "model"; the router stays replicated so
+            # every shard routes identically.  GSPMD inserts the token
+            # all-to-alls at the dispatch/combine einsums (models/moe.py).
+            specs[name] = {
+                "router": jax.tree_util.tree_map(lambda _: _REPL, sub["router"]),
+                "w_up": P(None, MODEL_AXIS, None, None),
+                "b_up": P(None, MODEL_AXIS, None),
+                "w_down": P(None, MODEL_AXIS, None, None),
+                "b_down": P(None, MODEL_AXIS, None),
+            }
         else:  # ln_attn / ln_mlp
             specs[name] = jax.tree_util.tree_map(lambda _: _REPL, sub)
     return specs

@@ -112,9 +112,16 @@ def _make_step_core(
                 {"params": p, "batch_stats": batch_stats},
                 x,
                 train=True,
-                mutable=["batch_stats"],
+                # "losses": auxiliary objectives sown by the model (the MoE
+                # load-balance loss, models/moe.py); absent for every other
+                # zoo model, where the collection comes back empty
+                mutable=["batch_stats", "losses"],
             )
-            return _cross_entropy(logits, labels).mean(), (logits, mutated)
+            aux = sum(
+                jnp.sum(leaf)
+                for leaf in jax.tree_util.tree_leaves(mutated.get("losses", {}))
+            )
+            return _cross_entropy(logits, labels).mean() + aux, (logits, mutated)
 
         (loss, (logits, mutated)), grads = jax.value_and_grad(
             loss_fn, has_aux=True

@@ -204,6 +204,15 @@ class Trainer:
                     f"({self.model.depth}) divisible by the model-parallel "
                     f"mesh axis ({mp_size}) to form equal stages"
                 )
+            if getattr(self.model, "num_experts", 0):
+                # the staged/sequence apply paths neither thread the sown
+                # MoE aux loss nor define per-shard routing semantics;
+                # experts shard over "model" under the tensor style (EP)
+                raise ValueError(
+                    f"--parallel-style {style} does not support MoE models; "
+                    "use the default tensor style, where --model-parallel "
+                    "shards the expert axis (expert parallelism)"
+                )
         self.train_fwd_bwd = None  # 1F1B replaces value_and_grad when set
         if style == "pipeline" and mp_size > 1:
             from ..parallel.pipeline import (

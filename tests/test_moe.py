@@ -1,0 +1,172 @@
+"""Switch-MoE FFN (models/moe.py) + expert parallelism.
+
+Beyond parity (the reference is CNN-only): routing/dispatch math against
+hand-computable cases, the sown load-balance loss, capacity-overflow
+dropping, and the EP sharding + training path on the virtual mesh.
+"""
+
+import flax.linen as lnn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu import models, parallel
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.models import SwitchFFN
+from distributed_training_comparison_tpu.train import (
+    Trainer,
+    configure_optimizers,
+    create_train_state,
+    make_train_step,
+)
+
+
+class HP:
+    lr = 0.1
+    weight_decay = 1e-4
+    lr_decay_step_size = 25
+    lr_decay_gamma = 0.1
+
+
+def _ffn(num_experts=2, dim=16, capacity_factor=1.0):
+    return SwitchFFN(
+        dim=dim, num_experts=num_experts, mlp_ratio=2,
+        capacity_factor=capacity_factor,
+    )
+
+
+def test_single_expert_equals_dense_mlp():
+    """With one expert the router is a constant softmax (gate == 1) and
+    capacity covers every token: the layer must equal the expert-0 MLP
+    applied densely — pinning the dispatch/combine one-hot algebra."""
+    ffn = _ffn(num_experts=1, capacity_factor=1.0)
+    x = jax.random.normal(jax.random.key(0), (2, 12, 16))
+    vars_ = ffn.init(jax.random.key(1), x)
+    out = ffn.apply(vars_, x)
+
+    p = vars_["params"]
+    h = jnp.einsum("bsd,dh->bsh", x, p["w_up"][0]) + p["b_up"][0]
+    dense = jnp.einsum("bsh,hd->bsd", lnn.gelu(h), p["w_down"][0]) + p["b_down"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=1e-5)
+
+
+def test_capacity_overflow_drops_tokens():
+    """Zeroed router → uniform probs, argmax ties to expert 0, so all n
+    tokens route there while capacity is only ~n/2: tokens past capacity
+    must contribute exactly zero (Switch drop semantics), earlier tokens
+    pass gate-weighted expert output."""
+    ffn = _ffn(num_experts=2, capacity_factor=1.0)
+    x = jax.random.normal(jax.random.key(0), (1, 32, 16))
+    vars_ = ffn.init(jax.random.key(1), x)
+    p = jax.tree_util.tree_map(jnp.asarray, vars_["params"])
+    p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+    p["router"]["bias"] = jnp.zeros_like(p["router"]["bias"])
+    out = ffn.apply({"params": p}, x)[0]  # (32, 16)
+
+    cap = 16  # ceil(32 * 1.0 / 2) = 16 (already a multiple of 8)
+    dropped = np.linalg.norm(np.asarray(out[cap:]), axis=-1)
+    kept = np.linalg.norm(np.asarray(out[:cap]), axis=-1)
+    np.testing.assert_allclose(dropped, 0.0, atol=1e-7)
+    assert (kept > 1e-3).all()
+    # kept tokens carry the tied gate probability 0.5
+    h = jnp.einsum("sd,dh->sh", x[0, :cap], p["w_up"][0]) + p["b_up"][0]
+    expert0 = jnp.einsum("sh,hd->sd", lnn.gelu(h), p["w_down"][0]) + p["b_down"][0]
+    np.testing.assert_allclose(
+        np.asarray(out[:cap]), 0.5 * np.asarray(expert0), atol=1e-5
+    )
+
+
+def test_aux_loss_sown_and_balanced_value():
+    """The Switch load-balance loss E·Σ_e f_e·P_e lands in the "losses"
+    collection when mutable, is ≥ aux_weight (equality at perfect
+    balance), and sow is a no-op when the collection is not mutable."""
+    ffn = _ffn(num_experts=4, dim=16)
+    x = jax.random.normal(jax.random.key(2), (2, 64, 16))
+    vars_ = ffn.init(jax.random.key(3), x)
+    out, mutated = ffn.apply(vars_, x, mutable=["losses"])
+    (aux,) = jax.tree_util.tree_leaves(mutated["losses"])
+    # E·Σ f·p == 1 at perfect balance; routing noise pushes it above
+    assert 0.9 * 0.01 <= float(aux) < 4 * 0.01
+    # not mutable → no-op, same output
+    out2 = ffn.apply(vars_, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=0)
+
+
+def test_vit_moe_trains_under_expert_parallelism():
+    """vit_moe end to end on a 4×2 mesh: the expert axis shards over
+    "model" (EP), the aux loss joins the objective, and two steps reduce
+    the loss."""
+    model = models.get_model("vit_moe", depth=2)
+    mesh = parallel.make_mesh(4, 2, backend="tpu")
+    tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+    state = create_train_state(model, jax.random.key(0), tx)
+    sharding = parallel.state_shardings(mesh, state)
+    from jax.sharding import PartitionSpec as P
+
+    assert sharding.params["blocks"]["moe"]["w_up"].spec == P(
+        None, "model", None, None
+    )
+    assert sharding.params["blocks"]["moe"]["router"]["kernel"].spec == P()
+    state = parallel.place_tree(state, sharding)
+    step = make_train_step(mesh, state_sharding=sharding)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, (32, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 100, (32,), dtype=np.int32)
+    bx, by = parallel.shard_batch((x, y), mesh)
+    losses = []
+    for i in range(3):
+        state, metrics = step(state, bx, by, jax.random.key(5))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_moe_aux_loss_joins_objective():
+    """The loss a train step reports must equal cross-entropy PLUS the sown
+    per-block aux losses — computed independently through a manual apply
+    with the "losses" collection mutable."""
+    from distributed_training_comparison_tpu.data.augment import normalize_images
+    from distributed_training_comparison_tpu.train.step import _cross_entropy
+
+    mesh = parallel.make_mesh(4, 2, backend="tpu")
+    model = models.get_model("vit_moe", depth=2)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+    state = create_train_state(model, jax.random.key(0), tx)
+    sharding = parallel.state_shardings(mesh, state)
+    state = parallel.place_tree(state, sharding)
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 100, (16,), dtype=np.int32)
+    bx, by = parallel.shard_batch((x, y), mesh)
+
+    step = make_train_step(mesh, augment=False, state_sharding=sharding)
+    _, metrics = step(state, bx, by, jax.random.key(2))
+    reported = float(metrics["loss"])
+
+    xn = normalize_images(jnp.asarray(x))
+    logits, mutated = state.apply_fn(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        xn, train=True, mutable=["batch_stats", "losses"],
+    )
+    ce = float(_cross_entropy(logits, jnp.asarray(y)).mean())
+    aux = float(
+        sum(jnp.sum(l) for l in jax.tree_util.tree_leaves(mutated["losses"]))
+    )
+    assert aux > 0
+    assert reported == pytest.approx(ce + aux, rel=1e-5)
+
+
+def test_trainer_rejects_moe_with_pipeline_style(tmp_path):
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "256",
+            "--model", "vit_moe",
+            "--batch-size", "32", "--model-parallel", "2",
+            "--parallel-style", "pipeline",
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    with pytest.raises(ValueError, match="does not support MoE"):
+        Trainer(hp)

@@ -180,7 +180,10 @@ def _build_step(style: str):
         lr_decay_step_size = 25
         lr_decay_gamma = 0.1
 
-    model = ViT(depth=8, dim=128, heads=4, patch=4)
+    model = ViT(
+        depth=8, dim=128, heads=4, patch=4,
+        num_experts=4 if style == "moe-ep" else 0,
+    )
     mp = {"dp": 1, "dp4-tp2": 2}.get(style, 4)
     mesh = parallel.make_mesh(8, mp, backend="tpu")
     tx, _ = configure_optimizers(HP, steps_per_epoch=10)
@@ -188,7 +191,9 @@ def _build_step(style: str):
     fwd_bwd = None
     grad_accum = 2 if style.endswith("accum2") else 1
 
-    if style in ("tp", "dp4-tp2"):
+    if style in ("tp", "dp4-tp2", "moe-ep"):
+        # moe-ep: the expert axis of the MoE FFN params shards over
+        # "model" (expert parallelism) via the same TP layout rules
         sharding = parallel.state_shardings(mesh, state)
     elif style.startswith("pp"):
         state = state.replace(
@@ -232,6 +237,7 @@ STYLES = (
     "pp-1f1b-accum2",   # PP composed with --grad-accum 2
     "sp-ring",
     "sp-ulysses",
+    "moe-ep",           # Switch-MoE FFN, expert axis sharded over "model"
 )
 
 
