@@ -154,13 +154,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, 
     qb = q_ref[...]
     nk_total = k_ref.shape[0] // block_k
     nk = _causal_nk(i, block_q, block_k, nk_total) if causal else nk_total
+    # key blocks strictly below the diagonal AND fully inside kv_len need
+    # no mask at all — the iota/compare/select per block is real VPU work
+    # next to the MXU matmuls.  Split the sweep: mask-free interior blocks
+    # first, masked boundary blocks (diagonal and/or padding) after.
+    nk_free = jnp.minimum(i * block_q, kv_len) // block_k if causal \
+        else kv_len // block_k
+    nk_free = jnp.minimum(nk_free, nk)
 
-    def body(j, carry):
+    def body(j, carry, *, masked):
         acc, m, l = carry
         kb = k_ref[pl.dslice(j * block_k, block_k), :]
         vb = v_ref[pl.dslice(j * block_k, block_k), :]
         s = _scores(qb, kb, scale)
-        s = jnp.where(_block_mask(i, j, block_q, block_k, kv_len, causal), s, _NEG_INF)
+        if masked:
+            s = jnp.where(
+                _block_mask(i, j, block_q, block_k, kv_len, causal), s, _NEG_INF
+            )
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -175,7 +185,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k, 
     acc = jnp.zeros((block_q, d), jnp.float32)
     m = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc, m, l))
+    carry = jax.lax.fori_loop(
+        0, nk_free, functools.partial(body, masked=False), (acc, m, l)
+    )
+    acc, m, l = jax.lax.fori_loop(
+        nk_free, nk, functools.partial(body, masked=True), carry
+    )
 
     l_safe = jnp.maximum(l, 1e-30)  # fully-masked (padded) rows stay finite
     o_ref[...] = (acc / l_safe).astype(o_ref.dtype)
