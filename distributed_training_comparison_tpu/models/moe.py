@@ -23,6 +23,13 @@ TPU-native design:
 - **Router in fp32** (standard practice — routing decisions are
   precision-sensitive; bf16 logits flip argmaxes), experts in the model's
   compute dtype.
+- **Cost model, measured honestly**: the dispatch/combine contractions
+  are O(n·E·cap·d) — at CIFAR dims they dominate the O(n·d·h) expert
+  FLOPs (v5e, depth-8/dim-192, bs256: 6.5k img/s MoE vs 34.9k dense
+  twin).  The formulation amortizes at LLM-scale d (dispatch grows
+  linearly in d, the experts quadratically); the known further
+  optimization is a sort/gather-based dispatch, which trades the one-hot
+  matmuls for data movement.
 - The Switch **load-balance auxiliary loss** ``E · Σ_e f_e·P_e`` is sown
   into a ``"losses"`` flax collection; the train step sums the collection
   into the objective (``train/step.py``).  ``sow`` is a no-op when the
