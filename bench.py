@@ -397,7 +397,14 @@ def main() -> None:
     else:
         ref_steps = 60
         configs = [
-            ("resnet18_bf16_bs256", "resnet18", "bf16", 256, 32, "cifar", 45_056, 3, {}),  # headline
+            # headline: the fastest accuracy-validated config — compute-dtype
+            # BN statistics (--bn-dtype compute; measured accuracy-equal to
+            # fp32 stats in the README's 50-epoch x3-seed study) is worth
+            # +5.6% on the memory-bound CIFAR stem
+            ("resnet18_bf16_bs256_bnc", "resnet18", "bf16", 256, 32, "cifar", 45_056, 3, {"norm_dtype": None}),
+            # reference-parity BN semantics (fp32 stat reduction, like the
+            # reference's AMP): the r1-r3 headline, kept for continuity
+            ("resnet18_bf16_bs256", "resnet18", "bf16", 256, 32, "cifar", 45_056, 3, {}),
             ("resnet18_fp32_bs256", "resnet18", "fp32", 256, 32, "cifar", 45_056, 3, {}),
             # BASELINE.json config 4 continuity leg (bs512 global = 64/chip
             # on the spec's v3-8; here the whole 512 is one chip's load)
