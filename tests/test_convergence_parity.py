@@ -1,0 +1,79 @@
+"""tools/convergence_parity.py — the 50-epoch torch-vs-flax harness.
+
+The full run is an offline evidence artifact (hours of single-core torch);
+CI pins the pieces that make the comparison valid: the torch-side
+normalize/augment must be the same transform the flax path applies, and
+the torch-side loop must run end to end on tiny settings.
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+spec = importlib.util.spec_from_file_location(
+    "convergence_parity", REPO / "tools" / "convergence_parity.py"
+)
+cp = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(cp)
+
+from distributed_training_comparison_tpu.data.augment import (  # noqa: E402
+    normalize_images,
+)
+
+
+def test_normalize_matches_flax_pipeline():
+    """The torch side's numpy normalize must be bit-comparable to the flax
+    path's normalize_images (same mean/std, ToTensor semantics) — else the
+    two frameworks would train on different data."""
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    ours = cp._normalize_np(u8)  # NCHW fp32
+    flax = np.transpose(np.asarray(normalize_images(jnp.asarray(u8))), (0, 3, 1, 2))
+    np.testing.assert_allclose(ours, flax, atol=1e-6)
+
+
+def test_augment_np_is_pad4_crop_flip():
+    """Every augmented image must be a 32×32 window of the zero-padded
+    input, possibly h-flipped — the reference's train transform."""
+    rng = np.random.default_rng(1)
+    u8 = rng.integers(1, 256, (6, 32, 32, 3), dtype=np.uint8)  # min 1: pad is 0
+    out = cp._augment_np(u8, np.random.default_rng(42))
+    assert out.shape == u8.shape and out.dtype == u8.dtype
+    pad = 4
+    padded = np.pad(u8, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    for i in range(len(u8)):
+        found = False
+        for r in range(2 * pad + 1):
+            for c in range(2 * pad + 1):
+                win = padded[i, r : r + 32, c : c + 32]
+                if (out[i] == win).all() or (out[i] == win[:, ::-1]).all():
+                    found = True
+                    break
+            if found:
+                break
+        assert found, f"image {i} is not a crop/flip of its padded source"
+    # determinism in the seed
+    out2 = cp._augment_np(u8, np.random.default_rng(42))
+    np.testing.assert_array_equal(out, out2)
+
+
+@pytest.mark.slow
+def test_torch_side_smoke():
+    """One tiny torch-side epoch end to end (reference net + recipe on the
+    loader's splits); finite metrics with the expected keys."""
+    result = cp.main(
+        [
+            "--skip-flax", "--epochs", "1", "--limit-examples", "256",
+            "--batch-size", "64", "--noise", "0.45",
+        ]
+    )
+    t = result["torch"]
+    for k in ("test_loss", "test_top1", "test_top5", "best_val_acc"):
+        assert np.isfinite(t[k]), k
