@@ -96,7 +96,9 @@ def _vit_trunk_specs(blocks: dict[str, Any]) -> dict[str, Any]:
             # expert parallelism: the expert axis (axis 1 behind the depth
             # stack) shards over "model"; the router stays replicated so
             # every shard routes identically.  GSPMD inserts the token
-            # all-to-alls at the dispatch/combine einsums (models/moe.py).
+            # redistribution at the dispatch boundary — the expert-buffer
+            # scatter/gathers of the default dispatch, or the dispatch/
+            # combine einsums under dispatch="onehot" (models/moe.py).
             specs[name] = {
                 "router": jax.tree_util.tree_map(lambda _: _REPL, sub["router"]),
                 "w_up": P(None, MODEL_AXIS, None, None),

@@ -77,6 +77,34 @@ def test_capacity_overflow_drops_tokens():
     )
 
 
+def test_gather_and_onehot_dispatch_agree():
+    """The sort/gather dispatch must reproduce the one-hot matmul
+    formulation exactly (same routing, same drops, same gating) — a stable
+    sort preserves within-expert original token order, so the kept sets
+    match the cumsum formulation."""
+    import dataclasses
+
+    base = _ffn(num_experts=4, dim=16, capacity_factor=0.5)  # force drops
+    x = jax.random.normal(jax.random.key(5), (2, 64, 16))
+    vars_ = base.init(jax.random.key(6), x)
+    out_g = dataclasses.replace(base, dispatch="gather").apply(vars_, x)
+    out_o = dataclasses.replace(base, dispatch="onehot").apply(vars_, x)
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.asarray(out_o), atol=2e-6
+    )
+    # under the bf16 policy (the bench configuration) the two paths apply
+    # the gate in different dtypes; they must still agree at bf16 tolerance
+    bf16 = dataclasses.replace(base, dtype=jnp.bfloat16)
+    g16 = dataclasses.replace(bf16, dispatch="gather").apply(vars_, x)
+    o16 = dataclasses.replace(bf16, dispatch="onehot").apply(vars_, x)
+    np.testing.assert_allclose(
+        np.asarray(g16, dtype=np.float32), np.asarray(o16, dtype=np.float32),
+        atol=3e-2,
+    )
+    with pytest.raises(ValueError, match="unknown MoE dispatch"):
+        dataclasses.replace(base, dispatch="nope").apply(vars_, x)
+
+
 def test_aux_loss_sown_and_balanced_value():
     """The Switch load-balance loss E·Σ_e f_e·P_e lands in the "losses"
     collection when mutable, is ≥ aux_weight (equality at perfect
