@@ -265,14 +265,14 @@ def bench_flash_attention(
             key = f"s{seq}" + ("_causal" if causal else "")
             cfac = 0.5 if causal else 1.0
             try:
-                t_f = timed_fwd(
+                t_f = _attempt(lambda: timed_fwd(
                     lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c),
                     q, k, v, 150,
-                )
-                t_fb = timed_fwd_bwd(
+                ))
+                t_fb = _attempt(lambda: timed_fwd_bwd(
                     lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c),
                     q, k, v, 30,
-                )
+                ))
                 out["configs"][key] = {
                     "fwd_tflops": round(cfac * fwd_flops / t_f / 1e12, 1),
                     "fwd_bwd_tflops": round(cfac * 2.5 * fwd_flops / t_fb / 1e12, 1),
@@ -319,6 +319,20 @@ def bench_reference_style(mesh, images, labels, batch_size: int, steps: int) -> 
     return steps * batch_size / dt
 
 
+def _attempt(fn, tries: int = 2):
+    """Run ``fn`` with one retry: the remote-compile service occasionally
+    drops a connection mid-compile ('response body closed before all bytes
+    were read'), and losing a leg's numbers to a transient is exactly the
+    failure mode this harness exists to avoid."""
+    for i in range(tries):
+        try:
+            return fn()
+        except Exception:
+            if i == tries - 1:
+                raise
+            emit_progress("retry", {"attempt": i + 1})
+
+
 def run_legs(mesh, configs, n_chips, peak):
     """Run every training-throughput leg, failure-isolated: one leg's
     compile/OOM failure records ``{"error": ...}`` for that leg and must
@@ -337,9 +351,11 @@ def run_legs(mesh, configs, n_chips, peak):
             images, labels = data_cache[n, image_size]
             if ref_data is None:
                 ref_data = (images, labels)
-            ips = bench_native(
-                mesh, images, labels, model_name, precision, batch, epochs, stem,
-                model_kw,
+            ips = _attempt(
+                lambda: bench_native(
+                    mesh, images, labels, model_name, precision, batch,
+                    epochs, stem, model_kw,
+                )
             )
             ips_chip = ips / n_chips
             flops = train_flops_per_image(model_name, image_size, stem, model_kw)

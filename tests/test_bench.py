@@ -39,6 +39,27 @@ def test_train_is_three_forwards():
     )
 
 
+def test_run_legs_retries_transient_failures(monkeypatch):
+    """A leg that fails once (the remote-compile service dropping a
+    connection) and succeeds on retry must record its numbers, not an
+    error."""
+    import bench
+
+    calls = {"n": 0}
+
+    def flaky_bench_native(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("response body closed before all bytes were read")
+        return 2000.0
+
+    monkeypatch.setattr(bench, "bench_native", flaky_bench_native)
+    configs = [("leg", "resnet18", "bf16", 64, 32, "cifar", 128, 1, {})]
+    per_config, _ = bench.run_legs(None, configs, 1, 197e12)
+    assert per_config["leg"]["images_per_sec_per_chip"] == 2000.0
+    assert calls["n"] == 2
+
+
 def test_run_legs_isolates_leg_failures(monkeypatch):
     """One leg blowing up (the round-3 failure mode: a compile OOM) must
     record an error for that leg only — every other leg's numbers survive."""
