@@ -18,9 +18,12 @@ counted analytically from the architecture (MACs × 2, backward ≈ 2×
 forward).  A long-sequence flash-attention leg reports the Pallas kernel's
 TF/s against the score-materializing jnp reference implementation.
 
-Output: ONE JSON line
+Output: ONE JSON line on stdout, budgeted to ≤1.5 KB so it always fits the
+driver's bounded tail capture (r4's full-detail line overflowed it and the
+round's headline was recorded unparsed) —
 ``{"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
-"detail": {...per-config...}}``.
+"detail": {ips/mfu/flash one number per leg}}``.  The complete per-leg
+record is written to ``BENCH_DETAIL.json`` and mirrored to stderr.
 """
 
 from __future__ import annotations
@@ -195,15 +198,19 @@ def bench_native(
 
 
 def bench_flash_attention(
-    seqs: tuple = (2048, 4096, 8192), ref_seq: int = 4096
+    seqs: tuple = (2048, 4096, 8192, 16384, 32768), ref_seq: int = 4096
 ) -> dict:
     """Pallas flash-attention kernel: forward TF/s and fwd+bwd TF/s at each
-    sequence length, causal and not (H=8, D=128, bf16; batch scaled so
-    total tokens stay constant).  The jnp-reference comparison runs at
-    ``ref_seq`` only (it materializes the S×S scores in HBM, so it is both
-    slow and memory-bound).  Kernel calls chain inside one ``lax.scan``
-    dispatch so tunnel/dispatch latency amortizes away (the same
-    one-dispatch trick the train path uses).
+    sequence length, causal and not (H=8, D=128, bf16; batch scaled to hold
+    16384 total tokens, floored at 1 — so S=16384 runs batch 1 [the
+    streamed-KV regime, making the README's long-S claims reproducible from
+    this committed harness, VERDICT r4 item 2] and S=32768 runs batch 1 at
+    DOUBLE the other legs' token budget; TF/s normalizes by FLOPs, so legs
+    stay comparable even though wall-time per call does not).  The jnp-reference
+    comparison runs at ``ref_seq`` only (it materializes the S×S scores in
+    HBM, so it is both slow and memory-bound).  Kernel calls chain inside
+    one ``lax.scan`` dispatch so tunnel/dispatch latency amortizes away
+    (the same one-dispatch trick the train path uses).
 
     FLOP accounting: forward = 4·b·h·S²·D (two matmuls, MACs×2); backward
     adds 6·b·h·S²·D (dq, dk, dv — three matmuls — plus the dp recompute
@@ -216,7 +223,7 @@ def bench_flash_attention(
     h, d = 8, 128
 
     def qkv(seq):
-        b = max(1, 8192 // seq) * 2
+        b = max(1, 16384 // seq)
         kq, kk, kv = jax.random.split(jax.random.key(0), 3)
         return (
             jax.random.normal(kq, (b, h, seq, d), jnp.bfloat16),
@@ -337,9 +344,11 @@ def run_legs(mesh, configs, n_chips, peak):
     """Run every training-throughput leg, failure-isolated: one leg's
     compile/OOM failure records ``{"error": ...}`` for that leg and must
     not zero the round's evidence (round 3 lost every number to a single
-    leg — VERDICT r3 item 2).  Returns (per_config, config-0 data)."""
+    leg — VERDICT r3 item 2).  Returns (per_config, dataset cache) — the
+    caller picks the baseline leg's data out of the cache by the headline
+    config's (n, image_size), so baseline and headline always share a
+    workload even when an early leg errors out."""
     per_config = {}
-    ref_data = None  # config-0 arrays, reused by the baseline leg
     data_cache = {}  # identical (n, image_size) datasets generated once
     for cfg_key, model_name, precision, batch, image_size, stem, n, epochs, model_kw in configs:
         try:
@@ -349,8 +358,6 @@ def run_legs(mesh, configs, n_chips, peak):
                     seed=0,
                 )
             images, labels = data_cache[n, image_size]
-            if ref_data is None:
-                ref_data = (images, labels)
             ips = _attempt(
                 lambda: bench_native(
                     mesh, images, labels, model_name, precision, batch,
@@ -387,7 +394,7 @@ def run_legs(mesh, configs, n_chips, peak):
         except Exception as e:
             per_config[cfg_key] = {"error": f"{type(e).__name__}: {e}"[:500]}
         emit_progress(cfg_key, per_config[cfg_key])
-    return per_config, ref_data
+    return per_config, data_cache
 
 
 def main() -> None:
@@ -405,10 +412,10 @@ def main() -> None:
     #  model_kw) — model_kw reaches the zoo constructor (norm_dtype=None is
     # --bn-dtype compute, accuracy-validated in README; scan_unroll=-1 is
     # the trainer's own TPU default; patch overrides the ViT patch size)
-    if platform == "cpu":  # CI smoke sizing
-        ref_steps = 4
+    if platform == "cpu":  # CI smoke sizing (this container: ONE cpu core)
+        ref_steps = 2
         configs = [
-            ("resnet18_bf16_bs128", "resnet18", "bf16", 128, 32, "cifar", 2_048, 1, {}),
+            ("resnet18_bf16_bs64", "resnet18", "bf16", 64, 32, "cifar", 256, 1, {}),
         ]
     else:
         ref_steps = 60
@@ -441,24 +448,42 @@ def main() -> None:
             # inputs — still below the flash kernel's measured crossover,
             # so the XLA path serves it (ops/attention.py dispatch)
             ("vit_tiny_p2_bf16_bs256", "vit_tiny", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "patch": 2}),
+            # Switch-MoE legs, both dispatch impls (README's MoE cost-model
+            # numbers must be reproducible from this committed harness —
+            # VERDICT r4 item 2).  MFU counts dense-equivalent (one expert
+            # per token) FLOPs, so capacity padding / router / dispatch all
+            # show up as honest overhead
+            ("vit_moe_bf16_bs256", "vit_moe", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1}),
+            ("vit_moe_onehot_bf16_bs256", "vit_moe", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "moe_dispatch": "onehot"}),
+            # the MoE trunk with num_experts=0: the depth-8/dim-192 dense
+            # twin the cost model compares against
+            ("vit_moe_dense_twin_bf16_bs256", "vit_moe", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "num_experts": 0}),
             # long-context leg at the kernel's design point: 4096 tokens,
             # head dim 128 — the Pallas kernel carries the model's
             # attention in-training here
             ("vit_long_bf16_bs8_256px", "vit_long", "bf16", 8, 256, "cifar", 512, 2, {"scan_unroll": -1, "image_size": 256}),
         ]
 
-    per_config, ref_data = run_legs(mesh, configs, n_chips, peak)
+    per_config, data_cache = run_legs(mesh, configs, n_chips, peak)
     ok = {k: v for k, v in per_config.items() if "error" not in v}
     headline_key = next(iter(ok), None)
     headline = ok[headline_key]["images_per_sec_per_chip"] if headline_key else None
-    try:
-        # baseline leg runs exactly the headline config's workload/data
-        ref_style = bench_reference_style(
-            mesh, ref_data[0], ref_data[1], configs[0][3], ref_steps
-        )
-    except Exception as e:
-        ref_style = None
-        emit_progress("reference_style", {"error": f"{type(e).__name__}: {e}"[:500]})
+    ref_style = None
+    if headline_key is not None:
+        # the baseline leg replays exactly the headline config's workload —
+        # looked up by headline_key, not position, so if the nominal
+        # headline leg errors out the baseline follows whichever leg
+        # actually headlines (ADVICE r4)
+        hcfg = next(c for c in configs if c[0] == headline_key)
+        try:
+            h_images, h_labels = data_cache[hcfg[6], hcfg[4]]
+            ref_style = bench_reference_style(
+                mesh, h_images, h_labels, hcfg[3], ref_steps
+            )
+        except Exception as e:
+            emit_progress(
+                "reference_style", {"error": f"{type(e).__name__}: {e}"[:500]}
+            )
     try:
         flash = (
             bench_flash_attention()
@@ -468,33 +493,81 @@ def main() -> None:
     except Exception as e:
         flash = {"error": f"{type(e).__name__}: {e}"[:500]}
 
-    print(
-        json.dumps(
-            {
-                "metric": "cifar100_resnet18_train_throughput",
-                "value": headline,
-                "unit": "images/sec/chip",
-                "vs_baseline": (
-                    round(headline * n_chips / ref_style, 3)
-                    if headline and ref_style
-                    else None
-                ),
-                "detail": {
-                    "platform": platform,
-                    "device_kind": jax.devices()[0].device_kind,
-                    "chips": n_chips,
-                    "chip_peak_bf16_tflops": round(peak / 1e12, 1) if peak else None,
-                    "configs": per_config,
-                    "flash_attention": flash,
-                    "reference_style_images_per_sec": (
-                        round(ref_style, 1) if ref_style else None
-                    ),
-                    "baseline_definition": "same chip, reference loop shape: "
-                    "per-step dispatch + H2D copy + per-step host sync, fp32",
-                },
-            }
-        )
-    )
+    record = {
+        "metric": "cifar100_resnet18_train_throughput",
+        "value": headline,
+        "unit": "images/sec/chip",
+        "vs_baseline": (
+            round(headline * n_chips / ref_style, 3)
+            if headline and ref_style
+            else None
+        ),
+        "detail": {
+            "platform": platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "chips": n_chips,
+            "chip_peak_bf16_tflops": round(peak / 1e12, 1) if peak else None,
+            "headline_key": headline_key,
+            "configs": per_config,
+            "flash_attention": flash,
+            "reference_style_images_per_sec": (
+                round(ref_style, 1) if ref_style else None
+            ),
+            "baseline_definition": "same chip, reference loop shape: "
+            "per-step dispatch + H2D copy + per-step host sync, fp32",
+        },
+    }
+    # The full record goes to a file + stderr; stdout gets ONE budgeted
+    # line.  The driver captures a bounded tail of stdout and parses the
+    # final JSON line — r4's line outgrew that window and the round's
+    # headline was recorded as ``parsed: null`` (VERDICT r4 item 1).
+    with open("BENCH_DETAIL.json", "w") as f:
+        json.dump(record, f, indent=1)
+    emit_progress("full_record", record)
+    print(compact_line(record))
+
+
+def compact_line(record: dict, budget: int = 1500) -> str:
+    """Compress the bench record to one stdout JSON line of at most
+    ``budget`` bytes: headline fields plus one number per training leg
+    (images/sec/chip), per-leg MFU, and one number per flash config
+    (fwd+bwd TF/s).  If the line still overflows — more legs than the
+    budget can carry — the most verbose sections are dropped in order,
+    never the headline fields.  The full record lives in
+    ``BENCH_DETAIL.json``."""
+    d = record["detail"]
+    flash = d.get("flash_attention") or {}
+    compact = {
+        "metric": record["metric"],
+        "value": record["value"],
+        "unit": record["unit"],
+        "vs_baseline": record["vs_baseline"],
+        "detail": {
+            "platform": d["platform"],
+            "device_kind": d["device_kind"],
+            "chips": d["chips"],
+            "headline_key": d["headline_key"],
+            "ips": {
+                k: v.get("images_per_sec_per_chip", "err")
+                for k, v in d["configs"].items()
+            },
+            "mfu": {
+                k: v["mfu"] for k, v in d["configs"].items() if v.get("mfu")
+            },
+            "flash_fwd_bwd_tflops": {
+                k: v.get("fwd_bwd_tflops", "err")
+                for k, v in (flash.get("configs") or {}).items()
+            },
+            "reference_style_images_per_sec": d["reference_style_images_per_sec"],
+            "full_record": "BENCH_DETAIL.json",
+        },
+    }
+    for drop in ("mfu", "flash_fwd_bwd_tflops", "ips"):
+        line = json.dumps(compact)
+        if len(line) <= budget:
+            return line
+        compact["detail"].pop(drop, None)
+    return json.dumps(compact)
 
 
 def emit_progress(key: str, result: dict) -> None:

@@ -146,6 +146,16 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "long-sequence regime on CIFAR inputs",
     )
     parser.add_argument(
+        "--moe-dispatch",
+        type=str,
+        default="gather",
+        choices=["gather", "onehot"],
+        help="MoE token-dispatch implementation (vit_moe): 'gather' = "
+        "sort/scatter/gather, O(n*d) data movement (default, measured "
+        "+55%% at CIFAR dims); 'onehot' = GShard-style dispatch/combine "
+        "matmuls, O(n*E*cap*d) MXU FLOPs (models/moe.py cost model)",
+    )
+    parser.add_argument(
         "--scan-unroll",
         type=int,
         default=0,

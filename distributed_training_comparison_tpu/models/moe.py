@@ -77,10 +77,14 @@ class SwitchFFN(nn.Module):
         b, s, d = x.shape
         n, e = b * s, self.num_experts
         hidden = self.mlp_ratio * d
-        # static capacity, padded to the fp32 sublane tile so the expert
-        # matmul shapes stay TPU-friendly
+        # static capacity, padded to the *compute dtype's* sublane tile so
+        # the expert matmul shapes stay TPU-friendly — 8 rows for fp32, 16
+        # for bf16 (8 × 4 bytes / itemsize); an 8-padded capacity under
+        # bf16 would leave odd multiples sub-tile-aligned (ADVICE r4).
+        # Routing semantics are unaffected: capacity only ever grows.
+        tile = 8 * 4 // jnp.dtype(self.dtype).itemsize
         cap = -(-n * self.capacity_factor // e)
-        cap = max(8, int(math.ceil(cap / 8) * 8))
+        cap = max(tile, int(math.ceil(cap / tile) * tile))
 
         xt = x.reshape(n, d)
         logits = nn.Dense(
@@ -101,6 +105,17 @@ class SwitchFFN(nn.Module):
             self.aux_weight * aux,
             reduce_fn=lambda a, b_: a + b_, init_fn=lambda: jnp.float32(0.0),
         )
+
+        # Routing health, sown into a non-loss collection ("moe_metrics")
+        # the train step surfaces as epoch metrics/TB scalars (VERDICT r4
+        # item 3: dropped tokens and per-expert load were computed and
+        # discarded — a collapsed router was invisible in the logs).
+        # Dispatch-independent: both impls keep exactly the first ``cap``
+        # tokens per expert of the same pre-capacity assignment.
+        counts = jnp.sum(onehot, axis=0)  # (e,) tokens routed per expert
+        dropped = jnp.sum(jnp.maximum(counts - cap, 0)).astype(jnp.float32) / n
+        self.sow("moe_metrics", "dropped_frac", dropped)
+        self.sow("moe_metrics", "expert_load", frac)  # (e,) sums to 1
 
         # batch_axis=0: fan-in/out from each expert's own (d, h) matrix —
         # plain xavier over the stacked 3D shape would fold the expert axis

@@ -56,6 +56,7 @@ class ViTBlock(nn.Module):
     attn_impl: str = "auto"
     num_experts: int = 0
     capacity_factor: float = 1.25
+    moe_dispatch: str = "gather"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, _carry_in=None):
@@ -98,6 +99,7 @@ class ViTBlock(nn.Module):
                 mlp_ratio=self.mlp_ratio,
                 capacity_factor=self.capacity_factor,
                 dtype=self.dtype,
+                dispatch=self.moe_dispatch,
                 name="moe",
             )(h)
             return x, None
@@ -126,6 +128,7 @@ class ViT(nn.Module):
     attn_impl: str = "auto"
     num_experts: int = 0  # > 0: Switch-MoE FFN in every block (models/moe.py)
     capacity_factor: float = 1.25
+    moe_dispatch: str = "gather"  # "gather" | "onehot" (models/moe.py cost model)
     remat: bool = False
     stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
     # lax.scan unroll factor for the trunk (params stay stacked either way,
@@ -163,8 +166,10 @@ class ViT(nn.Module):
         self.blocks = nn.scan(
             block,
             # "losses": the MoE aux loss sown per block stacks on the depth
-            # axis (a no-op collection for dense blocks)
-            variable_axes={"params": 0, "losses": 0},
+            # axis; "moe_metrics": per-block routing health (dropped-token
+            # fraction, expert load) stacks the same way (both are no-op
+            # collections for dense blocks)
+            variable_axes={"params": 0, "losses": 0, "moe_metrics": 0},
             split_rngs={"params": True},
             length=self.depth,
             unroll=self.depth if self.scan_unroll <= 0 else self.scan_unroll,
@@ -178,6 +183,7 @@ class ViT(nn.Module):
             attn_impl=self.attn_impl,
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
+            moe_dispatch=self.moe_dispatch,
         )
         self.ln_head = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)()
         self.head = nn.Dense(

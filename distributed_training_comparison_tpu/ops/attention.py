@@ -396,14 +396,22 @@ def _dkv_kernel(
     block_q = q_ref.shape[0]
     j = pl.program_id(1)
     i = pl.program_id(2)
-    # for causal, the first query block intersecting key block j; the init
-    # must run at the first *visited* i, which is lo, not 0
+    # for causal, the first query block intersecting key block j
     lo = (j * block_k) // block_q if causal else 0
 
-    @pl.when(i == lo)
+    @pl.when(i == 0)
     def _init():
+        # unconditional at the first inner step — AND pre-write the output
+        # blocks: under caller-chosen mismatched blocks (e.g. block_q=128,
+        # block_k=2048, s=2049) a causal key block can have lo >= nq, so no
+        # compute step ever visits it and the pre-written zeros (not stale
+        # scratch) are what flushes to HBM.  Such blocks are all-padding
+        # (sliced off by the pad VJP), but correctness here must not hang
+        # on that caller invariant (ADVICE r4).
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
+        dk_ref[...] = jnp.zeros_like(dk_acc).astype(dk_ref.dtype)
+        dv_ref[...] = jnp.zeros_like(dv_acc).astype(dv_ref.dtype)
 
     def compute():
         kb = k_ref[...]

@@ -49,6 +49,29 @@ def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
 
+def _moe_health(coll) -> Metrics:
+    """Aggregate the routing stats MoE layers sow into ``"moe_metrics"``
+    (models/moe.py) into two scalars: mean dropped-token fraction and mean
+    per-layer max expert load (1/E at perfect balance, → 1.0 when the
+    router collapses onto one expert).  Empty for dense models."""
+    from jax.tree_util import tree_flatten_with_path
+
+    dropped, load_max = [], []
+    for path, leaf in tree_flatten_with_path(coll)[0]:
+        keys = {getattr(p, "key", getattr(p, "name", "")) for p in path}
+        if "dropped_frac" in keys:
+            dropped.append(jnp.mean(leaf))
+        elif "expert_load" in keys:
+            # leaf: (..., depth, E) — max over experts, mean over layers
+            load_max.append(jnp.mean(jnp.max(leaf, axis=-1)))
+    out: Metrics = {}
+    if dropped:
+        out["moe_dropped_frac"] = jnp.mean(jnp.stack(dropped))
+    if load_max:
+        out["moe_load_max"] = jnp.mean(jnp.stack(load_max))
+    return out
+
+
 def _topk_hits(logits: jnp.ndarray, labels: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     _, top5 = jax.lax.top_k(logits, 5)
     hits = top5 == labels[:, None]
@@ -105,7 +128,7 @@ def _make_step_core(
                 )
             loss, logits, grads = fwd_bwd(params, x, labels)
             top1, _ = _topk_hits(logits, labels)
-            return grads, batch_stats, loss, top1.sum()
+            return grads, batch_stats, loss, top1.sum(), {}
 
         def loss_fn(p):
             logits, mutated = apply_fn(
@@ -113,9 +136,10 @@ def _make_step_core(
                 x,
                 train=True,
                 # "losses": auxiliary objectives sown by the model (the MoE
-                # load-balance loss, models/moe.py); absent for every other
-                # zoo model, where the collection comes back empty
-                mutable=["batch_stats", "losses"],
+                # load-balance loss, models/moe.py); "moe_metrics": routing
+                # health sown next to it; both collections come back empty
+                # for every dense zoo model
+                mutable=["batch_stats", "losses", "moe_metrics"],
             )
             aux = sum(
                 jnp.sum(leaf)
@@ -129,11 +153,12 @@ def _make_step_core(
         top1, _ = _topk_hits(logits, labels)
         # BN-free models mutate nothing; keep the (empty) stats tree stable
         new_stats = mutated.get("batch_stats", batch_stats)
-        return grads, new_stats, loss, top1.sum()
+        extras = _moe_health(mutated.get("moe_metrics", {}))
+        return grads, new_stats, loss, top1.sum(), extras
 
     def core(state: TrainState, images, labels, key: jax.Array):
         if grad_accum <= 1:
-            grads, new_stats, loss, top1_count = forward_backward(
+            grads, new_stats, loss, top1_count, extras = forward_backward(
                 state.params, state.apply_fn, state.batch_stats, images, labels, key
             )
             state = state.apply_gradients(grads=grads, batch_stats=new_stats)
@@ -141,6 +166,7 @@ def _make_step_core(
                 "loss": loss,
                 "top1_count": top1_count,
                 "count": labels.size,
+                **extras,
             }
 
         a = grad_accum
@@ -163,11 +189,13 @@ def _make_step_core(
         def micro_step(carry, inp):
             grads_sum, batch_stats = carry
             bx, by, k = inp
-            grads, new_stats, loss, top1_count = forward_backward(
+            grads, new_stats, loss, top1_count, extras = forward_backward(
                 state.params, state.apply_fn, batch_stats, bx, by, k
             )
             grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
-            return (grads_sum, new_stats), {"loss": loss, "top1": top1_count}
+            return (grads_sum, new_stats), {
+                "loss": loss, "top1": top1_count, **extras
+            }
 
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
         (grads_sum, final_stats), stacked = jax.lax.scan(
@@ -181,6 +209,11 @@ def _make_step_core(
             "loss": stacked["loss"].mean(),
             "top1_count": stacked["top1"].sum(),
             "count": labels.size,
+            **{
+                k: stacked[k].mean()
+                for k in stacked
+                if k.startswith("moe_")
+            },
         }
 
     return core

@@ -122,6 +122,7 @@ class Trainer:
             if unroll == 0:
                 unroll = -1 if jax.default_backend() == "tpu" else 1
             model_kw["scan_unroll"] = unroll
+            model_kw["moe_dispatch"] = getattr(hparams, "moe_dispatch", "gather")
         self.model = model if model is not None else get_model(
             hparams.model, **model_kw
         )
@@ -480,6 +481,20 @@ class Trainer:
             self._log_tb("loss/epoch/val", val["val_loss"], epoch)
             self._log_tb("acc/epoch/val", val["val_acc"], epoch)
             self._log_tb("throughput/images_per_sec", imgs / epoch_time, epoch)
+            for k, v in getattr(self, "_moe_health", {}).items():
+                # moe_dropped_frac → moe/dropped_frac, moe_load_max →
+                # moe/load_max: a collapsed router (load_max → 1.0) or
+                # capacity thrash (dropped_frac climbing) shows up per epoch
+                self._log_tb(f"moe/{k[len('moe_'):]}", v, epoch)
+            if getattr(self, "_moe_health", None):
+                self.logger.info(
+                    f"[{hp.backend.upper()} Version {self.version} Epoch "
+                    f"{epoch}] moe: "
+                    + ", ".join(
+                        f"{k[len('moe_'):]} {v:.4f}"
+                        for k, v in self._moe_health.items()
+                    )
+                )
 
             # Checkpoint decisions are computed on EVERY process from
             # replicated values (val metrics are identical across hosts) so
@@ -556,8 +571,24 @@ class Trainer:
             self.data_key,
             jnp.asarray(epoch),
         )
-        losses = np.asarray(stacked["loss"])  # one host fetch per epoch
-        top1 = float(np.sum(np.asarray(stacked["top1_count"])))
+        # ONE host fetch per epoch: loss/top1 and (MoE models only) the
+        # routing-health scalars come over the wire together — separate
+        # np.asarray calls would each pay a blocking round-trip (~95 ms on
+        # the tunneled bench host)
+        fetched = jax.device_get(
+            {
+                k: v
+                for k, v in stacked.items()
+                if k in ("loss", "top1_count") or k.startswith("moe_")
+            }
+        )
+        losses = np.asarray(fetched["loss"])
+        top1 = float(np.sum(fetched["top1_count"]))
+        # stashed for fit()'s TB/log pass rather than widening the return
+        self._moe_health = {
+            k: float(np.mean(v)) for k, v in fetched.items()
+            if k.startswith("moe_")
+        }
         return losses, top1
 
     def _train_epoch_host(self, epoch: int) -> tuple[np.ndarray, float]:
@@ -596,6 +627,13 @@ class Trainer:
             bar.close()
         losses = np.concatenate([np.asarray(m["loss"]) for m in chunk_metrics])
         top1 = float(sum(float(np.asarray(m["top1_count"]).sum()) for m in chunk_metrics))
+        self._moe_health = {
+            k: float(
+                np.concatenate([np.asarray(m[k]) for m in chunk_metrics]).mean()
+            )
+            for k in chunk_metrics[0]
+            if k.startswith("moe_")
+        }
         return losses, top1
 
     # ------------------------------------------------------------------- eval

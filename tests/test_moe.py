@@ -185,6 +185,86 @@ def test_moe_aux_loss_joins_objective():
     assert reported == pytest.approx(ce + aux, rel=1e-5)
 
 
+def test_routing_health_sown_values():
+    """Forced router collapse (zeroed router → argmax ties to expert 0):
+    the sown "moe_metrics" must read dropped_frac = (n-cap)/n and
+    expert_load = one-hot on expert 0 — the observability contract
+    (VERDICT r4: a collapsed router was invisible in the logs)."""
+    ffn = _ffn(num_experts=2, capacity_factor=1.0)
+    x = jax.random.normal(jax.random.key(0), (1, 32, 16))
+    vars_ = ffn.init(jax.random.key(1), x)
+    p = jax.tree_util.tree_map(jnp.asarray, vars_["params"])
+    p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+    p["router"]["bias"] = jnp.zeros_like(p["router"]["bias"])
+    _, mutated = ffn.apply({"params": p}, x, mutable=["moe_metrics"])
+    (dropped,) = mutated["moe_metrics"]["dropped_frac"]
+    (load,) = mutated["moe_metrics"]["expert_load"]
+    assert float(dropped) == pytest.approx(0.5)  # cap=16 of n=32 kept
+    np.testing.assert_allclose(np.asarray(load), [1.0, 0.0])
+
+
+def test_train_step_surfaces_routing_health():
+    """The train step must carry the routing stats out as metrics:
+    moe_dropped_frac / moe_load_max present, finite, and in-range for
+    vit_moe (dense models' metric dicts don't grow these keys — pinned by
+    every other step test's exact key-set assertions)."""
+    mesh = parallel.make_mesh(4, 2, backend="tpu")
+    model = models.get_model("vit_moe", depth=2)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+    state = create_train_state(model, jax.random.key(0), tx)
+    sharding = parallel.state_shardings(mesh, state)
+    state = parallel.place_tree(state, sharding)
+    step = make_train_step(mesh, state_sharding=sharding)
+    rng = np.random.default_rng(2)
+    x = rng.integers(0, 255, (32, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 100, (32,), dtype=np.int32)
+    bx, by = parallel.shard_batch((x, y), mesh)
+    _, metrics = step(state, bx, by, jax.random.key(1))
+    dropped = float(metrics["moe_dropped_frac"])
+    load_max = float(metrics["moe_load_max"])
+    assert 0.0 <= dropped < 1.0
+    # max expert load lies in [1/E, 1]; a fresh router should not have
+    # collapsed (load_max == 1.0 means every token on one expert)
+    assert 1.0 / 8 <= load_max <= 1.0
+
+
+def test_trainer_logs_moe_health_to_tensorboard(tmp_path):
+    """fit() on vit_moe must write moe/dropped_frac and moe/load_max TB
+    scalars (read back with tensorboard's own event reader) and a per-epoch
+    'moe:' log line."""
+    loader_mod = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_file_loader"
+    )
+    event_pb2 = pytest.importorskip("tensorboard.compat.proto.event_pb2")
+    import glob
+
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "128",
+            "--model", "vit_moe",
+            "--batch-size", "32", "--epoch", "1",
+            "--ckpt-path", str(tmp_path),
+        ],
+    )
+    trainer = Trainer(hp, model=models.get_model("vit_moe", depth=2))
+    version = trainer.fit()
+    trainer.close()
+    vdir = tmp_path / f"version-{version}"
+    f = glob.glob(str(vdir / "tb" / "events.out.tfevents.*"))[0]
+    tags = {}
+    # RawEventFileLoader + explicit parse, like test_tensorboard.py — the
+    # cooked loader rewrites simple_value into tensor protos
+    for raw in loader_mod.RawEventFileLoader(f).Load():
+        e = event_pb2.Event()
+        e.ParseFromString(raw)
+        for v in e.summary.value:
+            tags[v.tag] = v.simple_value
+    assert 0.0 <= tags["moe/dropped_frac"] < 1.0
+    assert 1.0 / 8 <= tags["moe/load_max"] <= 1.0
+    assert "moe: " in (vdir / "experiment.log").read_text()
+
+
 def test_trainer_rejects_moe_with_pipeline_style(tmp_path):
     hp = load_config(
         "tpu",

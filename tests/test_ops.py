@@ -153,6 +153,30 @@ def test_flash_non_pow2_padded_length(monkeypatch):
         assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
 
 
+def test_flash_causal_key_blocks_past_query_padding():
+    """Causal with caller blocks padding K/V far past the padded query
+    length (s=129, block_q=64, block_k=1024): the dkv backward grid gets
+    key blocks whose first intersecting query block lies beyond the grid
+    (lo >= nq), so no compute step visits them — the kernel's i==0
+    pre-write of zero output blocks (not stale scratch) is what flushes
+    (ADVICE r4).  Gradients on the real rows must match the reference."""
+    q, k, v, do = _rand_qkv(17, 129, 129, 64)
+    with jax.default_matmul_precision("highest"):
+        out_f, vjp_f = jax.vjp(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=1024, interpret=True
+            ),
+            q, k, v,
+        )
+        out_r, vjp_r = jax.vjp(
+            lambda q, k, v: mha_reference(q, k, v, causal=True), q, k, v
+        )
+        grads_f, grads_r = vjp_f(do), vjp_r(do)
+    assert float(jnp.max(jnp.abs(out_f - out_r))) < 2e-5
+    for gf, gr, name in zip(grads_f, grads_r, "qkv"):
+        assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
+
+
 def test_flash_explicit_blocks():
     """Non-default block shapes (incl. block_k spanning the whole padded
     sequence, the measured-fastest TPU config) agree with the default."""
