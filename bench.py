@@ -552,7 +552,9 @@ def compact_line(record: dict, budget: int = 1500) -> str:
                 for k, v in d["configs"].items()
             },
             "mfu": {
-                k: v["mfu"] for k, v in d["configs"].items() if v.get("mfu")
+                k: v["mfu"]
+                for k, v in d["configs"].items()
+                if v.get("mfu") is not None
             },
             "flash_fwd_bwd_tflops": {
                 k: v.get("fwd_bwd_tflops", "err")

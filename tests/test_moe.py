@@ -185,6 +185,25 @@ def test_moe_aux_loss_joins_objective():
     assert reported == pytest.approx(ce + aux, rel=1e-5)
 
 
+def test_capacity_pads_to_compute_dtype_tile():
+    """Capacity padding follows the compute dtype's sublane tile (8 rows
+    fp32, 16 bf16 — ADVICE r4): with a zeroed router (all n=80 tokens tie
+    to expert 0 of 2) and cf=0.5, raw capacity is 20 → 24 kept under
+    fp32, 32 kept under bf16.  Observable through the drop boundary."""
+    import dataclasses
+
+    x = jax.random.normal(jax.random.key(0), (1, 80, 16))
+    for dtype, want_kept in ((jnp.float32, 24), (jnp.bfloat16, 32)):
+        ffn = dataclasses.replace(_ffn(num_experts=2, capacity_factor=0.5), dtype=dtype)
+        vars_ = ffn.init(jax.random.key(1), x)
+        p = jax.tree_util.tree_map(jnp.asarray, vars_["params"])
+        p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+        p["router"]["bias"] = jnp.zeros_like(p["router"]["bias"])
+        out = ffn.apply({"params": p}, x)[0]
+        kept = int(jnp.sum(jnp.linalg.norm(out.astype(jnp.float32), axis=-1) > 1e-3))
+        assert kept == want_kept, (dtype, kept)
+
+
 def test_routing_health_sown_values():
     """Forced router collapse (zeroed router → argmax ties to expert 0):
     the sown "moe_metrics" must read dropped_frac = (n-cap)/n and

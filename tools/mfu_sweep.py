@@ -1,0 +1,85 @@
+"""One-factor-at-a-time MFU sweep for the sub-30% bench legs.
+
+VERDICT r4 item 5: resnet50/bs512 (26.9% MFU), rn50@224px (25.9-30.8%)
+and vit_tiny (~26%) trained at a quarter of peak with no documented
+reason.  This sweep isolates the two knobs those legs vary (batch size,
+BN-statistics dtype) one at a time, so the README's analysis can attribute
+the gap instead of guessing.  Reuses bench.py's measurement harness
+(scanned epochs, analytic FLOPs) so numbers are comparable 1:1 with the
+committed bench legs.
+
+Usage::
+
+    python tools/mfu_sweep.py            # rn50 batch x bn-dtype matrix
+    python tools/mfu_sweep.py vit        # vit_tiny variants
+
+Prints one JSON line per config to stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+from distributed_training_comparison_tpu import parallel  # noqa: E402
+from distributed_training_comparison_tpu.data import synthetic_dataset  # noqa: E402
+from distributed_training_comparison_tpu.utils import (  # noqa: E402
+    enable_persistent_compilation_cache,
+)
+
+# (key, model, batch, image_size, stem, n, epochs, model_kw)
+RN50_MATRIX = [
+    (f"rn50_bs{bs}_{tag}", "resnet50", bs, 32, "cifar", 45_056, 2, kw)
+    for bs in (128, 256, 512)
+    for tag, kw in (("bn_fp32", {}), ("bn_compute", {"norm_dtype": None}))
+]
+
+VIT_MATRIX = [
+    ("vit_tiny_base", "vit_tiny", 256, 32, "cifar", 45_056, 2,
+     {"scan_unroll": -1}),
+    ("vit_tiny_bs1024", "vit_tiny", 1024, 32, "cifar", 45_056, 2,
+     {"scan_unroll": -1}),
+    # LayerNorm statistics in compute dtype (the ViT analogue of the
+    # ResNet legs' bn_compute knob)
+    ("vit_tiny_ln_compute", "vit_tiny", 256, 32, "cifar", 45_056, 2,
+     {"scan_unroll": -1, "norm_dtype": None}),
+]
+
+
+def main() -> None:
+    enable_persistent_compilation_cache()
+    mesh = parallel.make_mesh(backend="tpu")
+    peak = bench.chip_peak_flops()
+    matrix = VIT_MATRIX if "vit" in sys.argv[1:] else RN50_MATRIX
+    for key, model, bs, size, stem, n, epochs, kw in matrix:
+        images, labels = synthetic_dataset(
+            n, num_classes=100, image_shape=(size, size, 3), seed=0
+        )
+        try:
+            ips = bench.bench_native(
+                mesh, images, labels, model, "bf16", bs, epochs, stem, kw
+            )
+        except Exception as e:  # keep sweeping; a failed cell is a datum
+            print(json.dumps({"key": key, "error": str(e)[:200]}), flush=True)
+            continue
+        flops = bench.train_flops_per_image(model, size, stem, kw)
+        print(
+            json.dumps(
+                {
+                    "key": key,
+                    "images_per_sec_per_chip": round(ips, 1),
+                    "mfu": round(ips * flops / peak, 4) if peak else None,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
