@@ -25,14 +25,16 @@ TPU-native design:
 - **Router in fp32** (standard practice — routing decisions are
   precision-sensitive; bf16 logits flip argmaxes), experts in the model's
   compute dtype.
-- **Cost model, measured honestly**: two dispatch implementations with
-  bit-equal routing.  The GShard-style one-hot matmuls are O(n·E·cap·d)
-  and dominate at CIFAR dims (v5e, depth-8/dim-192, bs256: 6.5k img/s vs
-  the 34.9k dense twin); the default sort/gather dispatch moves O(n·d)
-  data instead and reaches 10.0k img/s on the same config (+55%).  The
-  remaining gap to dense is the capacity padding (cf 1.25× expert-matmul
-  FLOPs), the router, and the gather/scatter traffic — all amortizing at
-  LLM-scale d.
+- **Cost model, measured honestly** (committed bench legs
+  ``vit_moe_bf16_bs256`` / ``vit_moe_onehot_bf16_bs256`` /
+  ``vit_moe_dense_twin_bf16_bs256``, ``bench.py``): two dispatch
+  implementations with bit-equal routing.  The GShard-style one-hot
+  matmuls are O(n·E·cap·d) and dominate at CIFAR dims (v5e,
+  depth-8/dim-192, bs256: 6.5k img/s vs the 35.0k dense twin); the
+  default sort/gather dispatch moves O(n·d) data instead and reaches
+  9.8k img/s on the same config (+52%).  The remaining gap to dense is
+  the capacity padding (cf 1.25× expert-matmul FLOPs), the router, and
+  the gather/scatter traffic — all amortizing at LLM-scale d.
 - The Switch **load-balance auxiliary loss** ``E · Σ_e f_e·P_e`` is sown
   into a ``"losses"`` flax collection; the train step sums the collection
   into the objective (``train/step.py``).  ``sow`` is a no-op when the
