@@ -30,7 +30,7 @@ TPU-native design:
   ``vit_moe_dense_twin_bf16_bs256``, ``bench.py``): two dispatch
   implementations with bit-equal routing.  The GShard-style one-hot
   matmuls are O(n·E·cap·d) and dominate at CIFAR dims (v5e,
-  depth-8/dim-192, bs256: 6.5k img/s vs the 35.0k dense twin); the
+  depth-8/dim-192, bs256: 6.5k img/s vs the 35.3k dense twin); the
   default sort/gather dispatch moves O(n·d) data instead and reaches
   9.8k img/s on the same config (+52%).  The remaining gap to dense is
   the capacity padding (cf 1.25× expert-matmul FLOPs), the router, and

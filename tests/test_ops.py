@@ -153,6 +153,45 @@ def test_flash_non_pow2_padded_length(monkeypatch):
         assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
 
 
+def test_flash_streamed_causal_mask_free_interior(monkeypatch):
+    """Streamed causal forward at S=4096 (forced via the resident limit):
+    with the 2048-row query tile the grid has interior tiles fully below
+    the diagonal — the causal mask-free branch of the streamed forward
+    (``_mask_split``) — plus straddling and skipped tiles.  All three
+    classes must agree with the reference."""
+    import importlib
+
+    A = importlib.import_module(
+        "distributed_training_comparison_tpu.ops.attention"
+    )
+    monkeypatch.setattr(A, "_FWD_RESIDENT_KV_LIMIT", 0)
+    q, k, v, _ = _rand_qkv(19, 4096, 4096, 64, b=1, h=1)
+    with jax.default_matmul_precision("highest"):
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        base = mha_reference(q, k, v, causal=True)
+    assert float(jnp.max(jnp.abs(out - base))) < 2e-5
+
+
+def test_flash_causal_backward_mask_free_interior():
+    """Causal fwd+bwd at S=1024: the backward's (512, 512) stream tiles
+    give both dq and dk/dv grids tiles fully below the diagonal — the
+    causal mask-free branch of both backward kernels — which smaller
+    causal tests (S<=512, single-tile grids) never reach."""
+    q, k, v, do = _rand_qkv(23, 1024, 1024, 64, b=1, h=2)
+    with jax.default_matmul_precision("highest"):
+        out_f, vjp_f = jax.vjp(
+            lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True),
+            q, k, v,
+        )
+        out_r, vjp_r = jax.vjp(
+            lambda q, k, v: mha_reference(q, k, v, causal=True), q, k, v
+        )
+        grads_f, grads_r = vjp_f(do), vjp_r(do)
+    assert float(jnp.max(jnp.abs(out_f - out_r))) < 2e-5
+    for gf, gr, name in zip(grads_f, grads_r, "qkv"):
+        assert float(jnp.max(jnp.abs(gf - gr))) < 5e-4, f"d{name} mismatch"
+
+
 def test_flash_causal_key_blocks_past_query_padding():
     """Causal with caller blocks padding K/V far past the padded query
     length (s=129, block_q=64, block_k=1024): the dkv backward grid gets
