@@ -448,12 +448,15 @@ def main() -> None:
             # inputs — still below the flash kernel's measured crossover,
             # so the XLA path serves it (ops/attention.py dispatch)
             ("vit_tiny_p2_bf16_bs256", "vit_tiny", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "patch": 2}),
-            # Switch-MoE legs, both dispatch impls (README's MoE cost-model
-            # numbers must be reproducible from this committed harness —
-            # VERDICT r4 item 2).  MFU counts dense-equivalent (one expert
-            # per token) FLOPs, so capacity padding / router / dispatch all
-            # show up as honest overhead
+            # Switch-MoE legs, all three dispatch impls (README's MoE
+            # cost-model numbers must be reproducible from this committed
+            # harness — VERDICT r4 item 2).  The unmarked leg resolves
+            # auto → the Pallas grouped-matmul kernel (ops/moe_gmm.py) on
+            # TPU.  MFU counts dense-equivalent (one expert per token)
+            # FLOPs, so capacity padding / router / dispatch all show up
+            # as honest overhead
             ("vit_moe_bf16_bs256", "vit_moe", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1}),
+            ("vit_moe_gather_bf16_bs256", "vit_moe", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "moe_dispatch": "gather"}),
             ("vit_moe_onehot_bf16_bs256", "vit_moe", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "moe_dispatch": "onehot"}),
             # the MoE trunk with num_experts=0: the depth-8/dim-192 dense
             # twin the cost model compares against

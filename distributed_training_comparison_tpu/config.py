@@ -148,12 +148,16 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
     parser.add_argument(
         "--moe-dispatch",
         type=str,
-        default="gather",
-        choices=["gather", "onehot"],
-        help="MoE token-dispatch implementation (vit_moe): 'gather' = "
-        "sort/scatter/gather, O(n*d) data movement (default, measured "
-        "+55%% at CIFAR dims); 'onehot' = GShard-style dispatch/combine "
-        "matmuls, O(n*E*cap*d) MXU FLOPs (models/moe.py cost model)",
+        default="auto",
+        choices=["auto", "gmm", "gather", "onehot"],
+        help="MoE token-dispatch implementation (vit_moe): 'gmm' = fused "
+        "Pallas grouped matmul over expert-sorted tokens (ops/moe_gmm.py, "
+        "the TPU fast path; unsharded experts only); 'gather' = "
+        "sort/scatter/gather, O(n*d) data movement, pure XLA (shards "
+        "under expert parallelism); 'onehot' = GShard-style "
+        "dispatch/combine matmuls, O(n*E*cap*d) MXU FLOPs (models/moe.py "
+        "cost model); 'auto' (default) = gmm on TPU with unsharded "
+        "experts, else gather",
     )
     parser.add_argument(
         "--scan-unroll",

@@ -12,11 +12,12 @@ TPU-native design:
 - **Static shapes everywhere.**  Capacity is static:
   ``ceil(tokens/experts · capacity_factor)``; tokens past an expert's
   capacity are *dropped* (their residual branch passes through
-  unchanged), exactly Switch semantics.  The default dispatch is a
-  stable-sort + scatter/gather over static-shaped buffers; the
-  alternative ``dispatch="onehot"`` expresses the same routing as one-hot
-  dispatch/combine tensors contracted on the MXU (the Switch/GShard
-  formulation) — see the cost model below.
+  unchanged), exactly Switch semantics.  The default dispatch resolves
+  to the fused Pallas grouped matmul over expert-sorted tokens on TPU
+  (``ops/moe_gmm.py``); the XLA alternatives are a stable-sort +
+  scatter/gather over static-shaped buffers (``"gather"``) and the
+  Switch/GShard one-hot dispatch/combine contraction (``"onehot"``) —
+  see the cost model below.
 - **Expert parallelism is a sharding, not code.**  Expert-stacked
   parameters ``(E, ...)`` carry a ``PartitionSpec`` placing the expert
   axis on the ``"model"`` mesh axis (``parallel/tp.py``); GSPMD inserts
@@ -26,15 +27,17 @@ TPU-native design:
   precision-sensitive; bf16 logits flip argmaxes), experts in the model's
   compute dtype.
 - **Cost model, measured honestly** (committed bench legs
-  ``vit_moe_bf16_bs256`` / ``vit_moe_onehot_bf16_bs256`` /
-  ``vit_moe_dense_twin_bf16_bs256``, ``bench.py``): two dispatch
-  implementations with bit-equal routing.  The GShard-style one-hot
-  matmuls are O(n·E·cap·d) and dominate at CIFAR dims (v5e,
-  depth-8/dim-192, bs256: 6.5k img/s vs the 35.3k dense twin); the
-  default sort/gather dispatch moves O(n·d) data instead and reaches
-  9.8k img/s on the same config (+52%).  The remaining gap to dense is
-  the capacity padding (cf 1.25× expert-matmul FLOPs), the router, and
-  the gather/scatter traffic — all amortizing at LLM-scale d.
+  ``vit_moe_bf16_bs256`` (auto → gmm) / ``vit_moe_gather_bf16_bs256`` /
+  ``vit_moe_onehot_bf16_bs256`` / ``vit_moe_dense_twin_bf16_bs256``,
+  ``bench.py``): three dispatch implementations with bit-equal routing.
+  The GShard-style one-hot matmuls are O(n·E·cap·d) and dominate at
+  CIFAR dims (v5e, depth-8/dim-192, bs256: 6.5k img/s vs the 35.3k
+  dense twin); the sort/gather dispatch moves O(n·d) data instead and
+  reaches 9.8k img/s; the fused Pallas grouped matmul removes the
+  capacity-buffer traffic on top and reaches ~13.2k (committed bench
+  legs carry the round's exact numbers).  The remaining gap to dense is
+  the token permutation in and out of sorted order (~40 cycles/row in
+  XLA's row gather at d=192) — amortizing at LLM-scale d.
 - The Switch **load-balance auxiliary loss** ``E · Σ_e f_e·P_e`` is sown
   into a ``"losses"`` flax collection; the train step sums the collection
   into the objective (``train/step.py``).  ``sow`` is a no-op when the
@@ -55,15 +58,30 @@ class SwitchFFN(nn.Module):
     """Top-1 (Switch) MoE feed-forward: router → dispatch → per-expert
     MLP → gate-weighted combine.
 
-    ``dispatch`` picks the token-shuffle implementation (both produce
+    ``dispatch`` picks the token-shuffle implementation (all produce
     bit-equal routing decisions; tested equivalent):
 
-    - ``"gather"`` (default): stable-sort tokens by expert, scatter into
-      the (E·cap, d) expert buffer, gather back — O(n·d) data movement.
+    - ``"gmm"``: sort tokens by expert and run the fused Pallas grouped
+      matmul (``ops/moe_gmm.py``) directly on the ragged groups — no
+      capacity-buffer scatter/gather, the expert MLP never leaves VMEM.
+      The TPU fast path; requires unsharded expert parameters (under
+      expert parallelism GSPMD can't partition a Pallas call — use
+      ``"gather"`` there, see ``train/trainer.py``).
+    - ``"gather"``: stable-sort tokens by expert, scatter into the
+      (E·cap, d) expert buffer, gather back — O(n·d) data movement,
+      pure XLA, shards under expert parallelism.
     - ``"onehot"``: the GShard-style one-hot dispatch/combine matmuls —
       O(n·E·cap·d) MXU FLOPs, which dominate at small model dims (the
       measured 5× slowdown at CIFAR scale) but keep everything on the
       MXU; the formulation of reference for parity tests.
+    - ``"auto"`` (default): ``"gmm"`` on a TPU backend, else ``"gather"``
+      (the train path overrides to ``"gather"`` under expert
+      parallelism, where the kernel can't shard).
+
+    An *explicit* ``"gmm"`` off-TPU runs through the Pallas interpreter —
+    the CPU-CI equivalence path, orders of magnitude slower than
+    ``"gather"``; use it for tests/debugging only (``"auto"`` never
+    selects it).
     """
 
     dim: int
@@ -72,7 +90,7 @@ class SwitchFFN(nn.Module):
     capacity_factor: float = 1.25
     dtype: Any = jnp.float32
     aux_weight: float = 0.01
-    dispatch: str = "gather"
+    dispatch: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -139,7 +157,37 @@ class SwitchFFN(nn.Module):
                 preferred_element_type=jnp.float32,
             ).astype(self.dtype) + b_down.astype(self.dtype)[:, None]
 
-        if self.dispatch == "onehot":
+        dispatch = self.dispatch
+        if dispatch == "auto":
+            dispatch = "gmm" if jax.default_backend() == "tpu" else "gather"
+        if dispatch == "gmm":
+            from ..ops.moe_gmm import grouped_ffn
+
+            # Counting sort, not argsort: rank-within-expert via cumsum
+            # over the (n, E) one-hot — a full 32-bit sort network costs
+            # ~15% of the layer's fwd+bwd at these dims (measured; the
+            # 1-D argsort/inverse/gather chain was pure overhead), and
+            # rank order == stable-sort order, so kept/dropped sets stay
+            # bit-identical to the "gather" branch.  The gate multiply
+            # happens in *unsorted* order (y is linear in ys), saving the
+            # gate[order] gather too.
+            pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=1) - 1
+            starts = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
+            )
+            dest = jnp.sum(starts[:e][None, :] * onehot, axis=1) + pos
+            xs = jnp.zeros((n, d), self.dtype).at[dest].set(
+                xt.astype(self.dtype)
+            )
+            ys = grouped_ffn(
+                xs,
+                w_up.astype(self.dtype), b_up.astype(self.dtype),
+                w_down.astype(self.dtype), b_down.astype(self.dtype),
+                starts, cap,
+                interpret=jax.default_backend() != "tpu",
+            )
+            y = jnp.take(ys, dest, axis=0) * gate.astype(self.dtype)[:, None]
+        elif dispatch == "onehot":
             # position of each token within its expert's buffer; -1 = not
             # routed there
             pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # (n, e) int32
@@ -160,7 +208,7 @@ class SwitchFFN(nn.Module):
                 "ecd,nec->nd", out_e, combine,
                 preferred_element_type=jnp.float32,
             )
-        elif self.dispatch == "gather":
+        elif dispatch == "gather":
             # stable sort by expert ⇒ within-expert order is original token
             # order, so kept/dropped sets are identical to the cumsum
             # formulation above

@@ -56,7 +56,7 @@ class ViTBlock(nn.Module):
     attn_impl: str = "auto"
     num_experts: int = 0
     capacity_factor: float = 1.25
-    moe_dispatch: str = "gather"
+    moe_dispatch: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, _carry_in=None):
@@ -128,7 +128,9 @@ class ViT(nn.Module):
     attn_impl: str = "auto"
     num_experts: int = 0  # > 0: Switch-MoE FFN in every block (models/moe.py)
     capacity_factor: float = 1.25
-    moe_dispatch: str = "gather"  # "gather" | "onehot" (models/moe.py cost model)
+    # "auto" | "gmm" | "gather" | "onehot" — models/moe.py cost model;
+    # auto = the fused Pallas grouped matmul on TPU, sort/gather elsewhere
+    moe_dispatch: str = "auto"
     remat: bool = False
     stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
     # lax.scan unroll factor for the trunk (params stay stacked either way,

@@ -122,7 +122,23 @@ class Trainer:
             if unroll == 0:
                 unroll = -1 if jax.default_backend() == "tpu" else 1
             model_kw["scan_unroll"] = unroll
-            model_kw["moe_dispatch"] = getattr(hparams, "moe_dispatch", "gather")
+            # "auto" resolves to the Pallas grouped-matmul dispatch on a
+            # TPU backend (models/moe.py) — except under expert
+            # parallelism, where GSPMD must shard the expert computation
+            # and only the XLA sort/gather formulation partitions
+            dispatch = getattr(hparams, "moe_dispatch", "auto")
+            is_moe = hparams.model == "vit_moe"
+            if is_moe and getattr(hparams, "model_parallel", 1) > 1:
+                if dispatch == "gmm":
+                    raise ValueError(
+                        "--moe-dispatch gmm requires unsharded experts: "
+                        "GSPMD cannot partition the Pallas grouped-matmul "
+                        "kernel over the model axis — use 'gather' (or "
+                        "'auto') with --model-parallel > 1"
+                    )
+                if dispatch == "auto":
+                    dispatch = "gather"
+            model_kw["moe_dispatch"] = dispatch
         self.model = model if model is not None else get_model(
             hparams.model, **model_kw
         )

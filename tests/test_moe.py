@@ -105,6 +105,85 @@ def test_gather_and_onehot_dispatch_agree():
         dataclasses.replace(base, dispatch="nope").apply(vars_, x)
 
 
+def test_gmm_dispatch_matches_gather():
+    """The Pallas grouped-matmul dispatch (interpret mode on CPU) must
+    reproduce the sort/gather formulation: same routing, same capacity
+    drops, same gating — outputs to fp32 roundoff, and the same gradients
+    for every parameter (the custom VJP mirrors XLA's einsum autodiff)."""
+    import dataclasses
+
+    base = _ffn(num_experts=4, dim=16, capacity_factor=0.5)  # force drops
+    x = jax.random.normal(jax.random.key(5), (2, 64, 16))
+    vars_ = base.init(jax.random.key(6), x)
+    out_g = dataclasses.replace(base, dispatch="gather").apply(vars_, x)
+    out_k = dataclasses.replace(base, dispatch="gmm").apply(vars_, x)
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.asarray(out_k), atol=2e-6
+    )
+
+    def loss(v, dispatch):
+        m = dataclasses.replace(base, dispatch=dispatch)
+        return jnp.sum(m.apply(v, x) ** 2)
+
+    g_g = jax.grad(loss)(vars_, "gather")
+    g_k = jax.grad(loss)(vars_, "gmm")
+    for (path, a), (_, b) in zip(
+        jax.tree_util.tree_leaves_with_path(g_g),
+        jax.tree_util.tree_leaves_with_path(g_k),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_gmm_empty_expert_groups():
+    """A router biased so hard that several experts (including the last)
+    receive zero tokens: the kernel's per-expert overlap guards and the
+    dW index-map clamp must handle empty groups at both ends — the
+    regression shape for the out-of-range tile DMA when starts[e] == n."""
+    import dataclasses
+
+    base = _ffn(num_experts=4, dim=16, capacity_factor=4.0)
+    x = jax.random.normal(jax.random.key(7), (1, 48, 16))
+    vars_ = base.init(jax.random.key(8), x)
+    p = jax.tree_util.tree_map(jnp.asarray, vars_["params"])
+    # all logits mass on expert 1: experts 0, 2, 3 get zero tokens
+    p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+    p["router"]["bias"] = jnp.asarray([-100.0, 100.0, -100.0, -100.0])
+    vs = {"params": p}
+
+    def loss(v, dispatch):
+        m = dataclasses.replace(base, dispatch=dispatch)
+        return jnp.sum(m.apply(v, x) ** 2)
+
+    out_g = dataclasses.replace(base, dispatch="gather").apply(vs, x)
+    out_k = dataclasses.replace(base, dispatch="gmm").apply(vs, x)
+    np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_k), atol=2e-6)
+    g_g = jax.grad(loss)(vs, "gather")["params"]
+    g_k = jax.grad(loss)(vs, "gmm")["params"]
+    np.testing.assert_allclose(
+        np.asarray(g_g["w_up"]), np.asarray(g_k["w_up"]), atol=5e-5
+    )
+    # untouched experts get exactly zero weight gradient from both paths
+    assert float(jnp.abs(g_k["w_up"][0]).max()) == 0.0
+    assert float(jnp.abs(g_k["w_up"][3]).max()) == 0.0
+
+
+def test_auto_dispatch_resolves_by_backend():
+    """dispatch="auto" (the default) must resolve to the XLA sort/gather
+    path off-TPU — bit-identical outputs on the CPU CI backend."""
+    import dataclasses
+
+    base = _ffn(num_experts=4, dim=16)
+    x = jax.random.normal(jax.random.key(9), (2, 32, 16))
+    vars_ = base.init(jax.random.key(10), x)
+    assert base.dispatch == "auto"
+    out_a = base.apply(vars_, x)
+    out_g = dataclasses.replace(base, dispatch="gather").apply(vars_, x)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_g), atol=0)
+
+
 def test_aux_loss_sown_and_balanced_value():
     """The Switch load-balance loss E·Σ_e f_e·P_e lands in the "losses"
     collection when mutable, is ≥ aux_weight (equality at perfect
@@ -282,6 +361,25 @@ def test_trainer_logs_moe_health_to_tensorboard(tmp_path):
     assert 0.0 <= tags["moe/dropped_frac"] < 1.0
     assert 1.0 / 8 <= tags["moe/load_max"] <= 1.0
     assert "moe: " in (vdir / "experiment.log").read_text()
+
+
+def test_trainer_rejects_gmm_under_expert_parallelism(tmp_path):
+    """An explicit --moe-dispatch gmm with --model-parallel > 1 must be a
+    clear config error (GSPMD can't partition the Pallas kernel over the
+    expert axis); 'auto' quietly resolves to 'gather' instead."""
+    argv = [
+        "--synthetic-data", "--limit-examples", "256",
+        "--model", "vit_moe",
+        "--batch-size", "32", "--model-parallel", "2",
+        "--moe-dispatch", "gmm",
+        "--ckpt-path", str(tmp_path),
+    ]
+    with pytest.raises(ValueError, match="unsharded experts"):
+        Trainer(load_config("tpu", argv=argv))
+    auto_argv = [a for a in argv if a not in ("--moe-dispatch", "gmm")]
+    hp = load_config("tpu", argv=auto_argv)
+    assert hp.moe_dispatch == "auto"
+    assert Trainer(hp).model.moe_dispatch == "gather"
 
 
 def test_trainer_rejects_moe_with_pipeline_style(tmp_path):
