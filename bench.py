@@ -445,8 +445,9 @@ def main() -> None:
             # trainer's TPU default path
             ("vit_tiny_bf16_bs256", "vit_tiny", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1}),
             # 256-token leg (patch 2): the long-sequence regime on CIFAR
-            # inputs — still below the flash kernel's measured crossover,
-            # so the XLA path serves it (ops/attention.py dispatch)
+            # inputs — served by the fused Pallas block kernel
+            # (ops/vit_block.py; models/vit.py gates it on for
+            # 128 <= S <= 512 on TPU, measured +28% on this leg)
             ("vit_tiny_p2_bf16_bs256", "vit_tiny", "bf16", 256, 32, "cifar", 45_056, 3, {"scan_unroll": -1, "patch": 2}),
             # Switch-MoE legs, all three dispatch impls (README's MoE
             # cost-model numbers must be reproducible from this committed

@@ -176,8 +176,13 @@ class SwitchFFN(nn.Module):
                 [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts, dtype=jnp.int32)]
             )
             dest = jnp.sum(starts[:e][None, :] * onehot, axis=1) + pos
+            # dest is a permutation of [0, n): promising uniqueness and
+            # bounds lets XLA emit a plain row scatter instead of the
+            # sort-based fallback (measured ~10% of the vit_moe step as
+            # u32[n, d] sort machinery without the promise)
             xs = jnp.zeros((n, d), self.dtype).at[dest].set(
-                xt.astype(self.dtype)
+                xt.astype(self.dtype),
+                unique_indices=True, mode="promise_in_bounds",
             )
             ys = grouped_ffn(
                 xs,
@@ -186,7 +191,9 @@ class SwitchFFN(nn.Module):
                 starts, cap,
                 interpret=jax.default_backend() != "tpu",
             )
-            y = jnp.take(ys, dest, axis=0) * gate.astype(self.dtype)[:, None]
+            y = ys.at[dest].get(
+                unique_indices=True, mode="promise_in_bounds"
+            ) * gate.astype(self.dtype)[:, None]
         elif dispatch == "onehot":
             # position of each token within its expert's buffer; -1 = not
             # routed there

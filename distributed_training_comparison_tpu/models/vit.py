@@ -36,9 +36,47 @@ from functools import partial
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from .norms import norm_policy
+
+
+class _DenseParams(nn.Module):
+    """Parameter mirror of ``nn.Dense(features, kernel_init=xavier)`` —
+    creates the identical ``{kernel, bias}`` leaves (same names, shapes,
+    dtypes, initializers, and path-derived RNG) without running the
+    matmul, so the fused-block kernel path shares one param tree with the
+    composed path (checkpoints and parallel styles interoperate)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int) -> dict:
+        xavier = nn.initializers.xavier_uniform()
+        return {
+            "kernel": self.param(
+                "kernel", xavier, (in_features, self.features), jnp.float32
+            ),
+            "bias": self.param(
+                "bias", nn.initializers.zeros, (self.features,), jnp.float32
+            ),
+        }
+
+
+class _LNParams(nn.Module):
+    """Parameter mirror of ``nn.LayerNorm`` (``{scale, bias}``)."""
+
+    @nn.compact
+    def __call__(self, features: int) -> dict:
+        return {
+            "scale": self.param(
+                "scale", nn.initializers.ones, (features,), jnp.float32
+            ),
+            "bias": self.param(
+                "bias", nn.initializers.zeros, (features,), jnp.float32
+            ),
+        }
 
 
 class ViTBlock(nn.Module):
@@ -46,7 +84,15 @@ class ViTBlock(nn.Module):
 
     ``num_experts > 0`` replaces the dense MLP with a Switch-style
     mixture-of-experts FFN (``models/moe.py``) — the expert axis is what
-    expert parallelism shards (``parallel/tp.py``)."""
+    expert parallelism shards (``parallel/tp.py``).
+
+    ``block_fusion`` gates the fully-fused Pallas block kernel
+    (``ops/vit_block.py``, one kernel for LN→qkv→MHA→proj→LN→MLP):
+    ``"auto"`` uses it on TPU for short-sequence dense blocks (the CIFAR
+    regime), ``"force"`` also off-TPU through the interpreter (CI),
+    ``"off"`` always composes — required whenever the block's
+    *parameters* are sharded (tensor parallelism), since GSPMD cannot
+    partition a pallas_call; the trainer makes that call."""
 
     dim: int
     heads: int
@@ -57,14 +103,57 @@ class ViTBlock(nn.Module):
     num_experts: int = 0
     capacity_factor: float = 1.25
     moe_dispatch: str = "auto"
+    block_fusion: str = "auto"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, _carry_in=None):
         from ..ops import attention
 
+        b, s, dim = x.shape
+        use_fused = (
+            self.block_fusion in ("auto", "force")
+            and self.num_experts == 0
+            and self.attn_impl == "auto"
+            and s % 8 == 0
+            and (dim // self.heads) % 8 == 0
+            # Measured crossover on a v5e (vit_tiny dims, bf16, bs256):
+            # at S=64 the composed XLA path still wins (18.8-20.4k vs
+            # 23.8k img/s — the kernel's stacked-score waste and backward
+            # recompute outweigh the relayouts it deletes), at S=256 the
+            # fused block wins 6.44k vs 5.04k (+28%).  Above 512 the
+            # flash path owns attention and scores would blow VMEM.
+            and 128 <= s <= 512
+            and (
+                jax.default_backend() == "tpu"
+                or self.block_fusion == "force"
+            )
+        )
+        if use_fused:
+            from ..ops.vit_block import fused_vit_block
+
+            params = {
+                "ln_attn": _LNParams(name="ln_attn")(dim),
+                "q_proj": _DenseParams(dim, name="q_proj")(dim),
+                "k_proj": _DenseParams(dim, name="k_proj")(dim),
+                "v_proj": _DenseParams(dim, name="v_proj")(dim),
+                "proj": _DenseParams(dim, name="proj")(dim),
+                "ln_mlp": _LNParams(name="ln_mlp")(dim),
+                "mlp_up": _DenseParams(self.mlp_ratio * dim, name="mlp_up")(dim),
+                "mlp_down": _DenseParams(dim, name="mlp_down")(
+                    self.mlp_ratio * dim
+                ),
+            }
+            out = fused_vit_block(
+                x.astype(self.dtype),
+                params,
+                heads=self.heads,
+                norm_f32=self.norm_dtype is not None,
+                interpret=jax.default_backend() != "tpu",
+            )
+            return out, None
+
         norm = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)
         xavier = nn.initializers.xavier_uniform()
-        b, s, dim = x.shape
         hd = dim // self.heads
 
         h = norm(name="ln_attn")(x).astype(self.dtype)
@@ -131,6 +220,10 @@ class ViT(nn.Module):
     # "auto" | "gmm" | "gather" | "onehot" — models/moe.py cost model;
     # auto = the fused Pallas grouped matmul on TPU, sort/gather elsewhere
     moe_dispatch: str = "auto"
+    # "auto" | "force" | "off" — the fully-fused Pallas block kernel
+    # (ops/vit_block.py); the trainer turns it off under tensor/pipeline
+    # parallelism, where block params shard (ViTBlock docstring)
+    block_fusion: str = "auto"
     remat: bool = False
     stem: str = "cifar"  # accepted for get_model compat; patch embed IS the stem
     # lax.scan unroll factor for the trunk (params stay stacked either way,
@@ -186,6 +279,7 @@ class ViT(nn.Module):
             num_experts=self.num_experts,
             capacity_factor=self.capacity_factor,
             moe_dispatch=self.moe_dispatch,
+            block_fusion=self.block_fusion,
         )
         self.ln_head = norm_policy(nn.LayerNorm, self.norm_dtype, self.dtype)()
         self.head = nn.Dense(

@@ -728,12 +728,33 @@ def attention(
         # D=64 the half-filled MXU lanes push the crossover to S=1024
         # (1.53x at 512, 0.93x/0.88x at 1024, 0.72x at 2048).  Below that,
         # one fused XLA softmax over big batched matmuls beats the
-        # per-(batch, head) kernel grid.
+        # per-(batch, head) kernel grid.  (The short-sequence kernel in
+        # ops/attention_small.py is NOT auto-selected: standalone it wins
+        # the attention sub-graph, but at the model level XLA re-lays the
+        # custom-call boundaries and the end-to-end step loses — the
+        # winning fused form at short S is the whole-block kernel,
+        # ops/vit_block.py, which models/vit.py dispatches itself.)
         min_seq = 512 if q.shape[-1] >= 128 else 1024
         impl = (
             "pallas"
             if on_tpu and kernel_ok and q.shape[seq_ax] >= min_seq
             else "reference"
+        )
+    if impl == "fused_small":
+        from .attention_small import small_mha
+
+        if return_lse:
+            raise ValueError("impl='fused_small' does not return lse")
+        if layout != "bshd":
+            raise ValueError("impl='fused_small' requires layout='bshd'")
+        if not interpret and jax.default_backend() != "tpu":
+            raise ValueError(
+                "attention(impl='fused_small') requires a TPU backend "
+                f"(current: {jax.default_backend()!r}). Pass interpret=True "
+                "to run the kernel through the Pallas interpreter off-TPU."
+            )
+        return small_mha(
+            q, k, v, causal=causal, scale=scale, interpret=interpret
         )
     if impl == "pallas":
         if not interpret and jax.default_backend() != "tpu":

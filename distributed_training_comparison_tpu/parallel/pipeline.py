@@ -178,6 +178,7 @@ def vit_stage_fn(
         dtype=model.dtype,
         norm_dtype=model.norm_dtype,
         attn_impl=model.attn_impl if attn_impl is None else attn_impl,
+        block_fusion=getattr(model, "block_fusion", "off"),
     )
 
     def stage(local_params, x):

@@ -139,6 +139,16 @@ class Trainer:
                 if dispatch == "auto":
                     dispatch = "gather"
             model_kw["moe_dispatch"] = dispatch
+            # the fused block kernel requires unsharded block params:
+            # tensor parallelism shards the projection/MLP kernels and
+            # pipeline stages re-drive blocks under shard_map — compose
+            # there (models/vit.py ViTBlock docstring)
+            if (
+                getattr(hparams, "model_parallel", 1) > 1
+                and getattr(hparams, "parallel_style", "tensor")
+                in ("tensor", "pipeline")
+            ):
+                model_kw["block_fusion"] = "off"
         self.model = model if model is not None else get_model(
             hparams.model, **model_kw
         )

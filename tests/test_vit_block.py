@@ -1,0 +1,170 @@
+"""Fused short-sequence attention + fused ViT block kernels.
+
+Both run through the Pallas interpreter on the CPU CI mesh; the compiled
+lowering is covered by ``tests_tpu/``.  The load-bearing property is
+*equivalence*: the fused paths must reproduce the composed flax path —
+same param tree, same init, same outputs, same gradients — so models can
+switch between them per-backend without retraining or checkpoint
+surgery.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu.models.vit import ViT, ViTBlock
+from distributed_training_comparison_tpu.ops.attention import mha_reference
+from distributed_training_comparison_tpu.ops.attention_small import (
+    pick_block_items,
+    small_mha,
+)
+
+
+@pytest.mark.parametrize(
+    "b,s,h,d,causal",
+    [
+        (8, 64, 3, 64, False),
+        (8, 64, 3, 64, True),
+        (4, 256, 3, 64, False),
+        (6, 24, 2, 16, True),  # small odd-ish dims, causal
+        (5, 64, 3, 64, False),  # b with no power-of-two tb divisor
+    ],
+)
+def test_small_mha_matches_reference(b, s, h, d, causal):
+    ks = jax.random.split(jax.random.key(42), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    ref = mha_reference(q, k, v, causal=causal, layout="bshd")
+    got = small_mha(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=2e-6)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(
+        loss(lambda q, k, v: mha_reference(q, k, v, causal=causal, layout="bshd")),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_got = jax.grad(
+        loss(lambda q, k, v: small_mha(q, k, v, causal=causal, interpret=True)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b_, name in zip(g_ref, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_small_mha_rejects_bad_shapes():
+    q = jnp.zeros((2, 64, 3, 64))
+    with pytest.raises(ValueError, match="self-attention only"):
+        small_mha(q, jnp.zeros((2, 32, 3, 64)), q, interpret=True)
+    with pytest.raises(ValueError, match="multiples of 8"):
+        small_mha(
+            jnp.zeros((2, 30, 3, 64)), jnp.zeros((2, 30, 3, 64)),
+            jnp.zeros((2, 30, 3, 64)), interpret=True,
+        )
+
+
+def test_pick_block_items_divides_batch():
+    assert pick_block_items(256, 64) == 8
+    assert pick_block_items(256, 256) == 2
+    assert pick_block_items(5, 64) == 5  # largest divisor of 5 under 8
+    assert pick_block_items(7, 4096) == 1
+
+
+@pytest.mark.parametrize("norm_dtype", [jnp.float32, None])
+def test_fused_block_matches_composed(norm_dtype):
+    """block_fusion="force" (interpret) vs "off": identical param trees
+    and inits (the _DenseParams/_LNParams mirrors), matching outputs and
+    gradients.  S=256 — the regime the gate actually selects."""
+    b, s_tokens, dim, heads = 2, 256, 64, 2
+    x = jax.random.normal(jax.random.key(0), (b, s_tokens, dim))
+    comp = ViTBlock(
+        dim=dim, heads=heads, norm_dtype=norm_dtype, block_fusion="off"
+    )
+    fused = dataclasses.replace(comp, block_fusion="force")
+    v1 = comp.init(jax.random.key(1), x)
+    v2 = fused.init(jax.random.key(1), x)
+    assert jtu.tree_structure(v1) == jtu.tree_structure(v2)
+    for (p, a), (_, b_) in zip(
+        jtu.tree_leaves_with_path(v1), jtu.tree_leaves_with_path(v2)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b_), err_msg=jtu.keystr(p)
+        )
+
+    y1, _ = comp.apply(v1, x, None)
+    y2, _ = fused.apply(v1, x, None)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=5e-5)
+
+    def loss(m, v):
+        y, _ = m.apply(v, x, None)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    g1 = jax.grad(lambda v: loss(comp, v))(v1)
+    g2 = jax.grad(lambda v: loss(fused, v))(v1)
+    for (p, a), (_, b_) in zip(
+        jtu.tree_leaves_with_path(g1), jtu.tree_leaves_with_path(g2)
+    ):
+        a, b_ = np.asarray(a), np.asarray(b_)
+        # atol scales with the leaf's own magnitude, floored at 1 so the
+        # ~0 gradients (k_proj bias — softmax shift-invariance) compare
+        # absolutely instead of amplifying their float noise
+        tol = 5e-4 * max(np.abs(a).max(), 1.0)
+        np.testing.assert_allclose(a, b_, atol=tol, err_msg=jtu.keystr(p))
+
+
+def test_fused_block_gate_regimes():
+    """The auto gate composes at S=64 (measured slower fused) and at
+    S > 512 (VMEM) even under "force"; MoE blocks always compose."""
+    dim, heads = 64, 2
+    block = ViTBlock(dim=dim, heads=heads, block_fusion="force")
+    x64 = jax.random.normal(jax.random.key(0), (2, 64, dim))
+    v = block.init(jax.random.key(1), x64)
+    # at S=64 force still composes: bit-identical to block_fusion="off"
+    y_force, _ = block.apply(v, x64, None)
+    y_off, _ = dataclasses.replace(block, block_fusion="off").apply(v, x64, None)
+    np.testing.assert_array_equal(np.asarray(y_force), np.asarray(y_off))
+    # MoE block under force at S=256 keeps the composed path (param tree
+    # proves it: the fused path creates no "moe" subtree)
+    moe = ViTBlock(
+        dim=dim, heads=heads, num_experts=2, block_fusion="force"
+    )
+    x256 = jax.random.normal(jax.random.key(2), (2, 256, dim))
+    vm = moe.init(jax.random.key(3), x256)
+    assert "moe" in vm["params"]
+
+
+def test_fused_vit_model_trains_and_matches():
+    """Whole-model check at patch 2 (256 tokens): a fused-trunk ViT and a
+    composed-trunk ViT agree on loss and produce finite matching grads —
+    the shape in which the trainer actually uses the kernel."""
+    kw = dict(
+        depth=2, dim=64, heads=2, patch=2, image_size=16, num_classes=10,
+        scan_unroll=-1,
+    )
+    comp = ViT(block_fusion="off", **kw)
+    fused = ViT(block_fusion="force", **kw)
+    x = jax.random.normal(jax.random.key(0), (4, 16, 16, 3))
+    yint = jnp.asarray([0, 1, 2, 3])
+    v = comp.init(jax.random.key(1), x)
+
+    def loss(m, v):
+        logits = m.apply(v, x)
+        return jnp.mean(
+            -jax.nn.log_softmax(logits)[jnp.arange(4), yint]
+        )
+
+    l1, g1 = jax.value_and_grad(lambda v: loss(comp, v))(v)
+    l2, g2 = jax.value_and_grad(lambda v: loss(fused, v))(v)
+    assert np.isfinite(float(l1)) and abs(float(l1) - float(l2)) < 1e-4
+    for (p, a), (_, b_) in zip(
+        jtu.tree_leaves_with_path(g1), jtu.tree_leaves_with_path(g2)
+    ):
+        a, b_ = np.asarray(a), np.asarray(b_)
+        tol = 1e-3 * max(np.abs(a).max(), 1.0)
+        np.testing.assert_allclose(a, b_, atol=tol, err_msg=jtu.keystr(p))
