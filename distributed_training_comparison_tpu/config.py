@@ -160,6 +160,22 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "experts, else gather",
     )
     parser.add_argument(
+        "--block-fusion",
+        type=str,
+        default="auto",
+        choices=["auto", "force", "off"],
+        help="fused Pallas transformer-block kernel (vit_*, "
+        "ops/vit_block.py): 'auto' = on TPU for dense blocks with "
+        "128 <= tokens <= 512 (the measured win regime; composed "
+        "automatically under tensor/pipeline model parallelism, where "
+        "block params shard); 'off' = always the composed XLA path; "
+        "'force' = fused even off-TPU through the Pallas interpreter "
+        "(tests/debugging). NOTE 'force' still composes silently outside "
+        "the 128-512 token window, for MoE blocks, and under sequence "
+        "parallelism (the kernel has no sequence-sharded form); it only "
+        "errors under tensor/pipeline model parallelism",
+    )
+    parser.add_argument(
         "--scan-unroll",
         type=int,
         default=0,

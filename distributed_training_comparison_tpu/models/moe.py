@@ -31,13 +31,13 @@ TPU-native design:
   ``vit_moe_onehot_bf16_bs256`` / ``vit_moe_dense_twin_bf16_bs256``,
   ``bench.py``): three dispatch implementations with bit-equal routing.
   The GShard-style one-hot matmuls are O(n·E·cap·d) and dominate at
-  CIFAR dims (v5e, depth-8/dim-192, bs256: 6.5k img/s vs the 35.3k
+  CIFAR dims (v5e, depth-8/dim-192, bs256: 6.5k img/s vs the 35.2k
   dense twin); the sort/gather dispatch moves O(n·d) data instead and
   reaches 9.8k img/s; the fused Pallas grouped matmul removes the
-  capacity-buffer traffic on top and reaches ~13.2k (committed bench
-  legs carry the round's exact numbers).  The remaining gap to dense is
-  the token permutation in and out of sorted order (~40 cycles/row in
-  XLA's row gather at d=192) — amortizing at LLM-scale d.
+  capacity-buffer traffic on top and reaches 15.3k (+56%; committed
+  bench legs carry the round's exact numbers).  The remaining gap to
+  dense is the token permutation in and out of sorted order (~40
+  cycles/row in XLA's row gather at d=192) — amortizing at LLM-scale d.
 - The Switch **load-balance auxiliary loss** ``E · Σ_e f_e·P_e`` is sown
   into a ``"losses"`` flax collection; the train step sums the collection
   into the objective (``train/step.py``).  ``sow`` is a no-op when the

@@ -120,7 +120,7 @@ class ViTBlock(nn.Module):
             # at S=64 the composed XLA path still wins (18.8-20.4k vs
             # 23.8k img/s — the kernel's stacked-score waste and backward
             # recompute outweigh the relayouts it deletes), at S=256 the
-            # fused block wins 6.44k vs 5.04k (+28%).  Above 512 the
+            # fused block wins 6.48k vs 5.04k (+29%).  Above 512 the
             # flash path owns attention and scores would blow VMEM.
             and 128 <= s <= 512
             and (

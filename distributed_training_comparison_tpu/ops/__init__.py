@@ -13,5 +13,15 @@ Dispatch helpers pick the kernel on TPU and the reference elsewhere.
 """
 
 from .attention import attention, flash_attention, mha_reference
+from .attention_small import small_mha
+from .moe_gmm import grouped_ffn
+from .vit_block import fused_vit_block
 
-__all__ = ["attention", "flash_attention", "mha_reference"]
+__all__ = [
+    "attention",
+    "flash_attention",
+    "fused_vit_block",
+    "grouped_ffn",
+    "mha_reference",
+    "small_mha",
+]

@@ -105,21 +105,61 @@ def _softmax_small(scd, s, causal, dtype):
     return (e / jnp.sum(e, axis=-1, keepdims=True)).astype(dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, tb, s, h, d, scale, causal):
+def _head_probs(qh, kh, tb, s, scale, causal):
+    """One head's stacked block-diagonal softmax probabilities: the
+    (rows, rows) score matmul, diagonal extraction, fp32 softmax.
+    Shared by this module's kernels and the fused block kernel
+    (ops/vit_block.py) so the numerics live in exactly one place."""
     rows = tb * s
-    for sl in _head_slices(h, d):
-        qh = q_ref[:, sl]
-        kh = k_ref[:, sl]
-        vh = v_ref[:, sl]
-        sc = jax.lax.dot_general(
-            qh, kh, (((1,), (1,)), ((), ())),
+    sc = jax.lax.dot_general(
+        qh, kh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    return _softmax_small(_extract_diag(sc, rows, tb, s), s, causal, jnp.float32)
+
+
+def head_fwd(qh, kh, vh, tb, s, scale, causal):
+    """(o, p_small) for one head of stacked block-diagonal attention."""
+    rows = tb * s
+    pf = _head_probs(qh, kh, tb, s, scale, causal)
+    p = _expand_diag(pf, rows, tb, s, qh.dtype)
+    o = jnp.dot(p, vh, preferred_element_type=jnp.float32).astype(qh.dtype)
+    return o, pf
+
+
+def head_bwd(qh, kh, vh, doh, pf, tb, s, scale):
+    """(dq, dk, dv) for one head given its saved/recomputed p_small.
+
+    The softmax VJP ``ds = p∘(dp − Σ(dp∘p))`` runs on the extracted
+    (rows, s) diagonal; ds and p re-expand for the MXU matmuls."""
+    rows = tb * s
+    dp = _extract_diag(
+        jax.lax.dot_general(
+            doh, vh, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        p_small = _softmax_small(
-            _extract_diag(sc, rows, tb, s), s, causal, jnp.float32
+        ),
+        rows, tb, s,
+    )
+    ds = pf * (dp - jnp.sum(dp * pf, axis=-1, keepdims=True))
+    ds = _expand_diag(ds * scale, rows, tb, s, qh.dtype)
+    p = _expand_diag(pf, rows, tb, s, qh.dtype)
+    dq = jnp.dot(ds, kh, preferred_element_type=jnp.float32).astype(qh.dtype)
+    dk = jax.lax.dot_general(
+        ds, qh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(qh.dtype)
+    dv = jax.lax.dot_general(
+        p, doh, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(qh.dtype)
+    return dq, dk, dv
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, tb, s, h, d, scale, causal):
+    for sl in _head_slices(h, d):
+        o, _ = head_fwd(
+            q_ref[:, sl], k_ref[:, sl], v_ref[:, sl], tb, s, scale, causal
         )
-        p = _expand_diag(p_small, rows, tb, s, qh.dtype)
-        o = jnp.dot(p, vh, preferred_element_type=jnp.float32)
         o_ref[:, sl] = o.astype(o_ref.dtype)
 
 
@@ -127,36 +167,10 @@ def _bwd_kernel(
     q_ref, k_ref, v_ref, do_ref, dq_ref, dk_ref, dv_ref,
     *, tb, s, h, d, scale, causal,
 ):
-    rows = tb * s
     for sl in _head_slices(h, d):
-        qh = q_ref[:, sl]
-        kh = k_ref[:, sl]
-        vh = v_ref[:, sl]
-        doh = do_ref[:, sl]
-        sc = jax.lax.dot_general(
-            qh, kh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        pf = _softmax_small(
-            _extract_diag(sc, rows, tb, s), s, causal, jnp.float32
-        )
-        dp_big = jax.lax.dot_general(
-            doh, vh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = _extract_diag(dp_big, rows, tb, s)
-        ds = pf * (dp - jnp.sum(dp * pf, axis=-1, keepdims=True))
-        ds = _expand_diag(ds * scale, rows, tb, s, qh.dtype)
-        p = _expand_diag(pf, rows, tb, s, qh.dtype)
-        dq = jnp.dot(ds, kh, preferred_element_type=jnp.float32)
-        dk = jax.lax.dot_general(
-            ds, qh, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dv = jax.lax.dot_general(
-            p, doh, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        qh, kh, vh = q_ref[:, sl], k_ref[:, sl], v_ref[:, sl]
+        pf = _head_probs(qh, kh, tb, s, scale, causal)
+        dq, dk, dv = head_bwd(qh, kh, vh, do_ref[:, sl], pf, tb, s, scale)
         dq_ref[:, sl] = dq.astype(dq_ref.dtype)
         dk_ref[:, sl] = dk.astype(dk_ref.dtype)
         dv_ref[:, sl] = dv.astype(dv_ref.dtype)

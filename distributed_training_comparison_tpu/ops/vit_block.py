@@ -43,7 +43,8 @@ In-kernel design notes:
   cheaper than round-tripping ``(B·S, 4D)`` activations through HBM.
 
 Measured regime (v5e, vit_tiny dims, bf16, bs256): the fused block wins
-from S≈256 (**6,443 vs 5,037 img/s on the 256-token patch-2 leg, +28%**)
+from S≈256 (**6,479 vs 5,037 img/s on the 256-token patch-2 leg, +29%**
+— committed capture ``vit_tiny_p2_bf16_bs256`` vs the r4 composed run)
 where the stacked-score waste is only 2×.  At S=64 it loses (18.8–20.4k
 vs 23.8k): tb=8 stacking wastes 8× score FLOPs, and the backward's
 full-chain recompute (~21 GFLOP/layer) exceeds what the deleted
@@ -72,12 +73,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .attention_small import (
-    _expand_diag,
-    _extract_diag,
-    _softmax_small,
-    pick_block_items,
-)
+from .attention_small import head_bwd, head_fwd, pick_block_items
 
 _LN_EPS = 1e-6
 
@@ -126,56 +122,39 @@ def _acc_T(a, g):
     )
 
 
+def _qkv_head(qkv, hh, d, dim):
+    return (
+        qkv[:, hh * d:(hh + 1) * d],
+        qkv[:, dim + hh * d:dim + (hh + 1) * d],
+        qkv[:, 2 * dim + hh * d:2 * dim + (hh + 1) * d],
+    )
+
+
 def _attn_fwd(qkv, tb, s, h, d, scale):
-    """Stacked block-diagonal MHA; returns (o, [p_small per head])."""
-    rows = tb * s
+    """Stacked block-diagonal MHA (shared per-head algebra:
+    ``attention_small.head_fwd``); returns (o, [p_small per head])."""
     dim = h * d
     outs, ps = [], []
     for hh in range(h):
-        qh = qkv[:, hh * d:(hh + 1) * d]
-        kh = qkv[:, dim + hh * d:dim + (hh + 1) * d]
-        vh = qkv[:, 2 * dim + hh * d:2 * dim + (hh + 1) * d]
-        sc = jax.lax.dot_general(
-            qh, kh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        pf = _softmax_small(
-            _extract_diag(sc, rows, tb, s), s, False, jnp.float32
-        )
-        p = _expand_diag(pf, rows, tb, s, qh.dtype)
-        outs.append(
-            jnp.dot(p, vh, preferred_element_type=jnp.float32).astype(qh.dtype)
-        )
+        o, pf = head_fwd(*_qkv_head(qkv, hh, d, dim), tb, s, scale, False)
+        outs.append(o)
         ps.append(pf)
     return jnp.concatenate(outs, axis=1), ps
 
 
 def _attn_bwd(qkv, ps, do, tb, s, h, d, scale):
-    """do (rows, dim) → dqkv (rows, 3*dim) in qkv.dtype."""
-    rows = tb * s
+    """do (rows, dim) → dqkv (rows, 3*dim) in qkv.dtype (shared per-head
+    algebra: ``attention_small.head_bwd``)."""
     dim = h * d
     dqs, dks, dvs = [], [], []
     for hh in range(h):
-        qh = qkv[:, hh * d:(hh + 1) * d]
-        kh = qkv[:, dim + hh * d:dim + (hh + 1) * d]
-        vh = qkv[:, 2 * dim + hh * d:2 * dim + (hh + 1) * d]
-        doh = do[:, hh * d:(hh + 1) * d]
-        pf = ps[hh]
-        dp = _extract_diag(
-            jax.lax.dot_general(
-                doh, vh, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ),
-            rows, tb, s,
+        qh, kh, vh = _qkv_head(qkv, hh, d, dim)
+        dq, dk, dv = head_bwd(
+            qh, kh, vh, do[:, hh * d:(hh + 1) * d], ps[hh], tb, s, scale
         )
-        ds = pf * (dp - jnp.sum(dp * pf, axis=-1, keepdims=True))
-        ds = _expand_diag(ds * scale, rows, tb, s, qh.dtype)
-        p = _expand_diag(pf, rows, tb, s, qh.dtype)
-        dqs.append(
-            jnp.dot(ds, kh, preferred_element_type=jnp.float32).astype(qh.dtype)
-        )
-        dks.append(_acc_T(ds, qh).astype(qh.dtype))
-        dvs.append(_acc_T(p, doh).astype(qh.dtype))
+        dqs.append(dq)
+        dks.append(dk)
+        dvs.append(dv)
     return jnp.concatenate(dqs + dks + dvs, axis=1)
 
 

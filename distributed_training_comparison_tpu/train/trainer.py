@@ -143,12 +143,22 @@ class Trainer:
             # tensor parallelism shards the projection/MLP kernels and
             # pipeline stages re-drive blocks under shard_map — compose
             # there (models/vit.py ViTBlock docstring)
+            fusion = getattr(hparams, "block_fusion", "auto")
             if (
                 getattr(hparams, "model_parallel", 1) > 1
                 and getattr(hparams, "parallel_style", "tensor")
                 in ("tensor", "pipeline")
             ):
-                model_kw["block_fusion"] = "off"
+                if fusion == "force":
+                    raise ValueError(
+                        "--block-fusion force requires unsharded block "
+                        "params: tensor/pipeline model parallelism shards "
+                        "them and GSPMD cannot partition the fused Pallas "
+                        "block kernel — use 'auto' (composes there) or "
+                        "'off' with --model-parallel > 1"
+                    )
+                fusion = "off"
+            model_kw["block_fusion"] = fusion
         self.model = model if model is not None else get_model(
             hparams.model, **model_kw
         )

@@ -81,7 +81,10 @@ def test_fused_block_matches_composed(norm_dtype):
     """block_fusion="force" (interpret) vs "off": identical param trees
     and inits (the _DenseParams/_LNParams mirrors), matching outputs and
     gradients.  S=256 — the regime the gate actually selects."""
-    b, s_tokens, dim, heads = 2, 256, 64, 2
+    # b=4 with s=256 gives tb=2 → grid of 2 steps, so the backward
+    # kernel's cross-tile accumulation (zero-init at step 0, '+=' on the
+    # revisited constant-index output blocks) actually executes in CI
+    b, s_tokens, dim, heads = 4, 256, 64, 2
     x = jax.random.normal(jax.random.key(0), (b, s_tokens, dim))
     comp = ViTBlock(
         dim=dim, heads=heads, norm_dtype=norm_dtype, block_fusion="off"
@@ -143,13 +146,16 @@ def test_fused_vit_model_trains_and_matches():
     """Whole-model check at patch 2 (256 tokens): a fused-trunk ViT and a
     composed-trunk ViT agree on loss and produce finite matching grads —
     the shape in which the trainer actually uses the kernel."""
+    # 32px at patch 2 → 256 tokens: inside the fused gate's
+    # 128 ≤ S ≤ 512 window, so "force" genuinely engages the kernel
+    # (16px/patch-2 would give 64 tokens and silently compose)
     kw = dict(
-        depth=2, dim=64, heads=2, patch=2, image_size=16, num_classes=10,
+        depth=2, dim=64, heads=2, patch=2, image_size=32, num_classes=10,
         scan_unroll=-1,
     )
     comp = ViT(block_fusion="off", **kw)
     fused = ViT(block_fusion="force", **kw)
-    x = jax.random.normal(jax.random.key(0), (4, 16, 16, 3))
+    x = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
     yint = jnp.asarray([0, 1, 2, 3])
     v = comp.init(jax.random.key(1), x)
 
@@ -168,3 +174,26 @@ def test_fused_vit_model_trains_and_matches():
         a, b_ = np.asarray(a), np.asarray(b_)
         tol = 1e-3 * max(np.abs(a).max(), 1.0)
         np.testing.assert_allclose(a, b_, atol=tol, err_msg=jtu.keystr(p))
+
+
+def test_block_fusion_config_plumbing(tmp_path):
+    """--block-fusion flows config → trainer → model; 'force' under
+    tensor model parallelism is a clear config error (sharded block
+    params can't feed a pallas_call), 'auto' quietly composes there."""
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.train import Trainer
+
+    base = [
+        "--synthetic-data", "--limit-examples", "256",
+        "--model", "vit_tiny", "--batch-size", "32",
+        "--ckpt-path", str(tmp_path),
+    ]
+    hp = load_config("tpu", argv=base)
+    assert hp.block_fusion == "auto"
+    assert Trainer(hp).model.block_fusion == "auto"
+
+    mp = base + ["--model-parallel", "2"]
+    hp = load_config("tpu", argv=mp)
+    assert Trainer(hp).model.block_fusion == "off"
+    with pytest.raises(ValueError, match="unsharded block params"):
+        Trainer(load_config("tpu", argv=mp + ["--block-fusion", "force"]))
