@@ -585,6 +585,130 @@ def emit_progress(key: str, result: dict) -> None:
     print(f"[bench] {key}: {json.dumps(result)}", file=sys.stderr, flush=True)
 
 
+def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
+    """The serving leg: engine + micro-batcher under closed- and open-loop
+    load, one committed JSON capture (``BENCH_SERVE.json``) the README's
+    latency/throughput table transcribes.
+
+    Weights are fresh-initialized (latency/throughput do not depend on
+    their values); the load shapes are the two canonical ones — a
+    closed-loop saturation run (peak batched throughput) and open-loop
+    Poisson runs at increasing offered rates (tail latency vs load, the
+    curve the queue-limit/deadline machinery exists for).  Sized down on
+    CPU so the capture is reproducible on the CI host.
+    """
+    from distributed_training_comparison_tpu.serve import (
+        MicroBatcher,
+        ServeEngine,
+        closed_loop,
+        open_loop,
+        request_pool,
+    )
+    from distributed_training_comparison_tpu.utils import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # CI smoke sizing (this container: few cpu cores)
+        model_name, image_size = "resnet18", 32
+        buckets = (1, 4, 8, 16)
+        closed_requests, closed_conc = 96, 8
+        open_rates, open_requests = (64.0, 256.0), 96
+        max_wait_ms, queue_limit = 2.0, 128
+    else:
+        model_name, image_size = "resnet18", 32
+        buckets = (1, 4, 16, 64, 256)
+        closed_requests, closed_conc = 8192, 64
+        open_rates, open_requests = (1000.0, 4000.0, 16000.0), 4096
+        max_wait_ms, queue_limit = 2.0, 4096
+
+    engine = ServeEngine(
+        model_name=model_name,
+        buckets=buckets,
+        precision="bf16",
+        image_size=image_size,
+    )
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    images = request_pool(
+        max(256, engine.max_bucket), image_size=image_size, seed=0
+    )
+    legs: dict = {}
+
+    def leg(key, fn):
+        try:
+            before = engine.stats()
+            with MicroBatcher(
+                engine, max_wait_ms=max_wait_ms, queue_limit=queue_limit
+            ) as batcher:
+                legs[key] = fn(batcher)
+            after = engine.stats()
+            # per-LEG engine counters (the shared engine accumulates
+            # across legs; a leg's record must carry only its own traffic)
+            legs[key]["engine"] = {
+                "buckets": after["buckets"],
+                "compiles": after["compiles"] - before["compiles"],
+                "cache_hits": after["cache_hits"] - before["cache_hits"],
+                "bucket_counts": {
+                    b: after["bucket_counts"][b] - before["bucket_counts"][b]
+                    for b in after["bucket_counts"]
+                },
+            }
+        except Exception as e:  # evidence over abort, like run_legs
+            legs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        emit_progress(key, legs[key])
+
+    leg(
+        f"closed_c{closed_conc}",
+        lambda b: closed_loop(
+            b, images, num_requests=closed_requests, concurrency=closed_conc
+        ),
+    )
+    for rate in open_rates:
+        leg(
+            f"open_r{int(rate)}",
+            lambda b, r=rate: open_loop(
+                b, images, rate_rps=r, num_requests=open_requests, seed=0
+            ),
+        )
+
+    record = {
+        "metric": "cifar100_resnet18_serve",
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "model": model_name,
+        "precision": "bf16",
+        "buckets": list(buckets),
+        "max_wait_ms": max_wait_ms,
+        "queue_limit": queue_limit,
+        "warmup_compile_s": round(warmup_s, 2),
+        "legs": legs,
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "metric": record["metric"],
+        "platform": platform,
+        "legs": {
+            k: (
+                {
+                    "rps": v.get("throughput_rps"),
+                    "p50_ms": v.get("latency_ms", {}).get("p50"),
+                    "p99_ms": v.get("latency_ms", {}).get("p99"),
+                    "shed": v.get("shed"),
+                }
+                if "error" not in v
+                else "err"
+            )
+            for k, v in legs.items()
+        },
+        "full_record": out_path,
+    }))
+    return record
+
+
 def smoke() -> None:
     """Compile + run one vit_long train step at its design point (4096
     tokens, D=128, batch 8 @ 256px) — the commit-time check that catches a
@@ -634,5 +758,7 @@ if __name__ == "__main__":
 
     if "--smoke" in sys.argv:
         smoke()
+    elif "--serve" in sys.argv:
+        bench_serve()
     else:
         main()

@@ -1,0 +1,71 @@
+"""Version tolerance for the narrow jax API surface that moved between
+releases.
+
+The framework targets the pinned ``requirements.txt`` jax, but the repo
+must also import (and its CPU tests must run) on the adjacent releases CI
+images carry.  Exactly three things have moved:
+
+- ``shard_map``: top-level ``jax.shard_map`` in newer releases, under
+  ``jax.experimental.shard_map`` before that;
+- its replication-check kwarg: ``check_vma`` today, ``check_rep`` in
+  older releases (same meaning — the wrapper translates);
+- ``jax.lax.axis_size``: absent in older releases, where the idiom is
+  ``psum(1, axis)`` (folded to the static size on a constant operand);
+- the Pallas TPU compiler-params dataclass: ``pltpu.CompilerParams``
+  today, ``pltpu.TPUCompilerParams`` in older releases (same fields).
+
+Import them from here; everything else in the codebase uses stable API.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` with the ``check_vma``→``check_rep`` kwarg rename
+    papered over (callers use the current name)."""
+    if not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+import jax as _jax
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` with the pre-export fallback (``psum(1, ·)``
+    over a constant folds to the static mapped-axis size)."""
+    fn = getattr(_jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return _jax.lax.psum(1, axis_name)
+
+
+from jax.experimental.pallas import tpu as _pltpu
+
+
+def _missing_compiler_params(*_a, **_k):
+    raise ImportError(
+        "this jax release exposes neither pltpu.CompilerParams nor "
+        "pltpu.TPUCompilerParams — the Pallas kernels need one of them; "
+        "install a requirements.txt-adjacent jax"
+    )
+
+
+# Resolved lazily-failing rather than raising at import: only the Pallas
+# kernel call sites need it, and the rest of the package must stay
+# importable on such a jax.
+CompilerParams = getattr(
+    _pltpu,
+    "CompilerParams",
+    getattr(_pltpu, "TPUCompilerParams", _missing_compiler_params),
+)
+
+__all__ = ["shard_map", "axis_size", "CompilerParams"]

@@ -170,10 +170,12 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "automatically under tensor/pipeline model parallelism, where "
         "block params shard); 'off' = always the composed XLA path; "
         "'force' = fused even off-TPU through the Pallas interpreter "
-        "(tests/debugging). NOTE 'force' still composes silently outside "
-        "the 128-512 token window, for MoE blocks, and under sequence "
-        "parallelism (the kernel has no sequence-sharded form); it only "
-        "errors under tensor/pipeline model parallelism",
+        "(tests/debugging). NOTE 'force' still composes outside the "
+        "128-512 token window, for MoE blocks, over the VMEM weight "
+        "budget, and under sequence parallelism (the kernel has no "
+        "sequence-sharded form) — a one-time warning names the declined "
+        "condition; it only errors under tensor/pipeline model "
+        "parallelism",
     )
     parser.add_argument(
         "--scan-unroll",
@@ -342,6 +344,78 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Capture a jax.profiler trace of one steady-state epoch into "
         "this directory (view with TensorBoard's profile plugin / Perfetto)",
     )
+    # serving (serve/ subsystem: engine + micro-batcher + load generators)
+    parser.add_argument(
+        "--serve",
+        action="store_true",
+        default=False,
+        help="Run the batched/sharded inference engine + load harness "
+        "instead of training: restore a checkpoint (--serve-ckpt), "
+        "compile one predict program per batch bucket, and drive it with "
+        "the configured load generator, printing a latency/throughput "
+        "report (serve/)",
+    )
+    parser.add_argument(
+        "--serve-ckpt",
+        type=str,
+        default=None,
+        help="Checkpoint to serve (a best_model_*.ckpt or last.ckpt). "
+        "Default: the newest version dir's best checkpoint under "
+        "--ckpt-path; if none exists the engine serves fresh-initialized "
+        "weights (load-testing mode) with a warning",
+    )
+    parser.add_argument(
+        "--serve-buckets",
+        type=str,
+        default="1,2,4,8,16,32",
+        help="Comma-separated padded batch-size buckets. Ragged request "
+        "batches round up to the nearest bucket, so jit compiles exactly "
+        "one predict program per bucket and ragged traffic never "
+        "recompiles; the largest bucket is the micro-batcher's "
+        "max coalesced batch",
+    )
+    parser.add_argument(
+        "--max-wait-ms",
+        type=float,
+        default=2.0,
+        help="Micro-batcher coalescing window: a batch is dispatched when "
+        "it reaches the largest bucket or the oldest queued request has "
+        "waited this long",
+    )
+    parser.add_argument(
+        "--queue-limit",
+        type=int,
+        default=256,
+        help="Load-shed bound: submissions beyond this queue depth are "
+        "rejected with a typed QueueOverflow error (graceful degradation "
+        "instead of unbounded latency)",
+    )
+    parser.add_argument(
+        "--serve-rate",
+        type=float,
+        default=0.0,
+        help="Open-loop load: Poisson arrival rate in requests/sec "
+        "(0 = closed-loop at --serve-concurrency in-flight requests)",
+    )
+    parser.add_argument(
+        "--serve-requests",
+        type=int,
+        default=512,
+        help="Total requests the load generator offers",
+    )
+    parser.add_argument(
+        "--serve-concurrency",
+        type=int,
+        default=8,
+        help="Closed-loop load: number of in-flight requests",
+    )
+    parser.add_argument(
+        "--deadline-ms",
+        type=float,
+        default=0.0,
+        help="Per-request deadline; expired requests are failed with a "
+        "typed DeadlineExceeded error before wasting compute (0 = none)",
+    )
     parser.add_argument(
         "--legacy-test-stats",
         action="store_true",
@@ -364,4 +438,16 @@ def load_config(
         parser.error(f"--limit-examples must be >= 0, got {args.limit_examples}")
     if args.precision is None:
         args.precision = "bf16" if args.amp else "fp32"
+    try:
+        buckets = tuple(
+            sorted({int(t) for t in args.serve_buckets.split(",") if t.strip()})
+        )
+    except ValueError:
+        buckets = ()
+    if not buckets or buckets[0] < 1:
+        parser.error(
+            f"--serve-buckets must be positive integers, got "
+            f"{args.serve_buckets!r}"
+        )
+    args.serve_buckets = buckets
     return args

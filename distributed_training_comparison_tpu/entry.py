@@ -24,10 +24,24 @@ from .utils import enable_persistent_compilation_cache
 
 
 def run(backend: str, argv: Sequence[str] | None = None) -> dict:
-    """Train (and optionally test) one run of the given backend variant."""
+    """Train (and optionally test) one run of the given backend variant.
+
+    ``--serve`` routes to the serving subsystem instead: restore a
+    checkpoint this same entry trained, compile the bucketed predict
+    programs, and drive them with the configured load generator
+    (``serve/``; launcher ``src/tpu_jax/run_serve.sh``).
+    """
     hparams = load_config(backend, argv)
     enable_persistent_compilation_cache()
     init_distributed(hparams)
+
+    if getattr(hparams, "serve", False):
+        from .serve import serve_main
+
+        results = serve_main(hparams)
+        if is_main_process():
+            print(results)
+        return results
 
     trainer = Trainer(hparams)
     results: dict = {}

@@ -17,7 +17,7 @@ from .resnet import (
     ResNet101,
     ResNet152,
 )
-from .moe import SwitchFFN
+from .moe import SwitchFFN, resolve_dispatch
 from .vit import ViT, ViTBlock, ViTLong, ViTMoE, ViTSmall, ViTTiny
 
 _ZOO = {
@@ -33,12 +33,24 @@ _ZOO = {
 }
 
 
-def get_model(name: str, **kwargs):
-    """Build a zoo model by CLI name (e.g. ``"resnet18"``, ``"vit_tiny"``)."""
+def get_model(name: str, *, expert_parallel: bool = False, **kwargs):
+    """Build a zoo model by CLI name (e.g. ``"resnet18"``, ``"vit_tiny"``).
+
+    ``expert_parallel=True`` declares that the caller will shard
+    expert-stacked parameters over the ``"model"`` mesh axis; the MoE
+    dispatch is then resolved sharding-aware at construction (``'auto'``
+    falls back to the partitionable ``'gather'``, an explicit ``'gmm'``
+    is rejected) — for *every* caller, not just the Trainer
+    (``models.moe.resolve_dispatch``).
+    """
     try:
         ctor = _ZOO[name.lower()]
     except KeyError:
         raise ValueError(f"unknown model {name!r}; choices: {sorted(_ZOO)}") from None
+    if name.lower().startswith("vit"):
+        kwargs["moe_dispatch"] = resolve_dispatch(
+            kwargs.get("moe_dispatch", "auto"), expert_parallel=expert_parallel
+        )
     return ctor(**kwargs)
 
 
@@ -59,4 +71,5 @@ __all__ = [
     "ViTMoE",
     "SwitchFFN",
     "get_model",
+    "resolve_dispatch",
 ]

@@ -53,6 +53,36 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..ops.vmem import fits_weight_budget, gmm_weight_bytes
+
+
+def resolve_dispatch(dispatch: str = "auto", *, expert_parallel: bool = False) -> str:
+    """Sharding-aware dispatch resolution, usable at model construction.
+
+    Expert parallelism (expert-stacked params sharded over the ``"model"``
+    mesh axis) rules the Pallas grouped-matmul kernel out: GSPMD cannot
+    partition a ``pallas_call``, so only the XLA ``"gather"`` formulation
+    shards.  This used to be Trainer-private knowledge — every other
+    caller (bench harnesses, ``__graft_entry__.py``, the serve engine)
+    had to hand-pin ``'gather'`` or hand GSPMD an unpartitionable kernel
+    (ADVICE r5 #1).  ``models.get_model(..., expert_parallel=True)``
+    routes through here, so the fallback now lives next to the dispatch
+    choice for *all* callers.
+
+    Backend/VMEM concerns stay call-time (``SwitchFFN.__call__`` knows
+    the real dims there); this resolves only the sharding question, so an
+    ``"auto"`` with unsharded experts passes through unchanged.
+    """
+    if not expert_parallel:
+        return dispatch
+    if dispatch == "gmm":
+        raise ValueError(
+            "MoE dispatch 'gmm' requires unsharded experts: GSPMD cannot "
+            "partition the Pallas grouped-matmul kernel over the model "
+            "axis — use 'gather' (or 'auto') under expert parallelism"
+        )
+    return "gather" if dispatch == "auto" else dispatch
+
 
 class SwitchFFN(nn.Module):
     """Top-1 (Switch) MoE feed-forward: router → dispatch → per-expert
@@ -159,7 +189,20 @@ class SwitchFFN(nn.Module):
 
         dispatch = self.dispatch
         if dispatch == "auto":
-            dispatch = "gmm" if jax.default_backend() == "tpu" else "gather"
+            # gmm keeps all E experts' weights VMEM-resident for the whole
+            # grid; a config whose static footprint exceeds the budget
+            # would fail Mosaic compilation — compose via gather instead
+            # of crashing (ADVICE r5 #2).  Sharding-awareness (expert
+            # parallelism → gather) is resolved at construction by
+            # resolve_dispatch; only backend/footprint remain here.
+            gmm_fits = fits_weight_budget(
+                gmm_weight_bytes(e, d, hidden, self.dtype)
+            )
+            dispatch = (
+                "gmm"
+                if jax.default_backend() == "tpu" and gmm_fits
+                else "gather"
+            )
         if dispatch == "gmm":
             from ..ops.moe_gmm import grouped_ffn
 

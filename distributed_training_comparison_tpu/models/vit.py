@@ -32,6 +32,7 @@ embed is the stem).
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import Any
 
@@ -39,7 +40,31 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..ops.vmem import fits_weight_budget, fused_block_weight_bytes
 from .norms import norm_policy
+
+# reasons already warned about when --block-fusion force silently composed
+# (one warning per distinct reason per process; tests may clear this)
+_FUSION_FORCE_WARNED: set[str] = set()
+
+
+def _warn_force_composed(reason: str) -> None:
+    """One-time warning when ``block_fusion='force'`` is declined.
+
+    'force' silently composing was documented in help text only — a user
+    benchmarking 'force' could measure the composed path believing the
+    kernel ran (ADVICE r5 #3).  Emitted at trace time, once per distinct
+    reason, naming the condition that failed.
+    """
+    if reason in _FUSION_FORCE_WARNED:
+        return
+    _FUSION_FORCE_WARNED.add(reason)
+    warnings.warn(
+        "--block-fusion force: the fused Pallas block kernel was declined "
+        f"({reason}); this block runs the composed XLA path",
+        UserWarning,
+        stacklevel=2,
+    )
 
 
 class _DenseParams(nn.Module):
@@ -110,24 +135,46 @@ class ViTBlock(nn.Module):
         from ..ops import attention
 
         b, s, dim = x.shape
+        # Structural gate conditions, checked in order; the first failure
+        # is what the force-decline warning names.
+        declined = []
+        if self.num_experts != 0:
+            declined.append("MoE block (the kernel has no expert FFN form)")
+        if self.attn_impl != "auto":
+            declined.append(f"attn_impl={self.attn_impl!r} pins attention")
+        if s % 8 or (dim // self.heads) % 8:
+            declined.append(
+                f"tokens ({s}) and head dim ({dim // self.heads}) must be "
+                "multiples of 8"
+            )
+        # Measured crossover on a v5e (vit_tiny dims, bf16, bs256):
+        # at S=64 the composed XLA path still wins (18.8-20.4k vs
+        # 23.8k img/s — the kernel's stacked-score waste and backward
+        # recompute outweigh the relayouts it deletes), at S=256 the
+        # fused block wins 6.48k vs 5.04k (+29%).  Above 512 the
+        # flash path owns attention and scores would blow VMEM.
+        if not 128 <= s <= 512:
+            declined.append(f"{s} tokens outside the measured 128-512 window")
+        # The kernel keeps every block weight VMEM-resident (backward adds
+        # an fp32 accumulator per parameter); a config whose static
+        # footprint exceeds the budget would die in Mosaic compilation —
+        # compose instead (ADVICE r5 #2).
+        wbytes = fused_block_weight_bytes(dim, self.mlp_ratio, self.dtype)
+        if not fits_weight_budget(wbytes):
+            declined.append(
+                f"static VMEM weight footprint {wbytes / 2**20:.1f} MiB "
+                "exceeds the kernel budget"
+            )
         use_fused = (
             self.block_fusion in ("auto", "force")
-            and self.num_experts == 0
-            and self.attn_impl == "auto"
-            and s % 8 == 0
-            and (dim // self.heads) % 8 == 0
-            # Measured crossover on a v5e (vit_tiny dims, bf16, bs256):
-            # at S=64 the composed XLA path still wins (18.8-20.4k vs
-            # 23.8k img/s — the kernel's stacked-score waste and backward
-            # recompute outweigh the relayouts it deletes), at S=256 the
-            # fused block wins 6.48k vs 5.04k (+29%).  Above 512 the
-            # flash path owns attention and scores would blow VMEM.
-            and 128 <= s <= 512
+            and not declined
             and (
                 jax.default_backend() == "tpu"
                 or self.block_fusion == "force"
             )
         )
+        if self.block_fusion == "force" and not use_fused:
+            _warn_force_composed(declined[0])
         if use_fused:
             from ..ops.vit_block import fused_vit_block
 

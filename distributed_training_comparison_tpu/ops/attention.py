@@ -57,6 +57,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .._compat import CompilerParams
+
 _NEG_INF = -1e30  # finite "-inf": keeps fully-masked rows NaN-free
 
 
@@ -325,7 +327,7 @@ def _flash_fwd_tiled(q3, k3, v3, scale, causal, block_q, kv_len, interpret):
             pltpu.VMEM((bq, 8), jnp.float32),
         ],
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
     )(q3, k3, v3)
@@ -526,7 +528,7 @@ def _flash_bwd(q3, k3, v3, out3, lse, do3, dlse, scale, causal, kv_len, interpre
     nq, nk = sq // bq, skv // bk
     # bh and the own-block grid dims are independent; only the innermost
     # (streaming, accumulating) dim must execute in order
-    params = pltpu.CompilerParams(
+    params = CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 

@@ -42,7 +42,7 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DATA_AXIS, MODEL_AXIS
@@ -62,7 +62,7 @@ def pipeline_stages(
     axis.  Returns ``(M, mb, ...)`` outputs, replicated (broadcast from
     the last stage).
     """
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
     is_first = idx == 0
@@ -241,7 +241,7 @@ def _one_f_one_b(
     Returns ``(loss, trunk_grads_local, head_grads, dtokens, logits)``,
     already psum'd over the data axis where the quantity is batch-reduced.
     """
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     m = microbatches.shape[0]
     is_first = idx == 0

@@ -34,7 +34,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import _NEG_INF as _NEG_BIG, attention
@@ -72,7 +72,7 @@ def ring_attention(
     head-dim rule ``1/sqrt(D)`` (identical local/global — D is unsharded).
     """
     axis = jax.lax.axis_index(axis_name)
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = axis_size(axis_name)
     b, h, s_local, d = q.shape
     acc_dtype = jnp.float32
 
@@ -128,7 +128,7 @@ def ulysses_attention(
     ordinary attention (the Pallas kernel on TPU — at full sequence
     length, where it shines), then redistribute back to sequence shards.
     """
-    p_size = jax.lax.axis_size(axis_name)
+    p_size = axis_size(axis_name)
     if q.shape[1] % p_size:
         raise ValueError(
             f"ulysses needs heads ({q.shape[1]}) divisible by the axis size "

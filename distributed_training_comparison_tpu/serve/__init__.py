@@ -1,0 +1,189 @@
+"""Serving subsystem: batched, sharded inference + a load-generating bench.
+
+The train side of this repo ends at the Trainer's eval loop; this package
+is the inference path the ROADMAP's "serves heavy traffic" north star
+asks for, built on the same assets — the SPMD mesh/sharding layer, the
+Pallas kernels, and ``train/checkpoint.py``'s files:
+
+- ``engine.py``   — per-bucket AOT-compiled, donated-buffer predict over
+                    any mesh layout training produces (DP/TP/MoE);
+- ``batcher.py``  — request queue + micro-batcher with coalescing,
+                    per-request deadlines, and typed load shedding;
+- ``loadgen.py``  — closed-loop and open-loop (Poisson) load generators;
+- ``metrics.py``  — p50/p95/p99 latency, throughput, queue depth, shed
+                    counts, wired into ``utils/{logging,tensorboard}``.
+
+``serve_main`` is the CLI entry behind ``--serve`` (``entry.py`` /
+``src/tpu_jax/run_serve.sh``): build the engine from the run's flags and
+checkpoint dir, drive it with the configured load shape, and report.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+
+from .batcher import (
+    BatcherClosed,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+    ServeError,
+    ServeFuture,
+)
+from .engine import DEFAULT_BUCKETS, ServeEngine
+from .loadgen import closed_loop, open_loop, request_pool
+from .metrics import ServeMetrics, latency_summary_ms
+
+__all__ = [
+    "ServeEngine",
+    "DEFAULT_BUCKETS",
+    "MicroBatcher",
+    "ServeFuture",
+    "ServeError",
+    "QueueOverflow",
+    "DeadlineExceeded",
+    "BatcherClosed",
+    "ServeMetrics",
+    "latency_summary_ms",
+    "closed_loop",
+    "open_loop",
+    "request_pool",
+    "build_engine",
+    "serve_main",
+]
+
+
+def build_engine(hparams, mesh=None) -> ServeEngine:
+    """A ``ServeEngine`` from a parsed flag namespace (``config.py``).
+
+    Model construction mirrors the Trainer's flag mapping (dtype from
+    ``--precision``/``--amp``, ViT image/patch sizing, MoE dispatch and
+    block-fusion policies) so a checkpoint trains and serves from the
+    same flags.  Only the tensor parallel style serves; pipeline and
+    sequence styles shard *activations through training-only apply fns*
+    and have no serving form here.
+    """
+    style = getattr(hparams, "parallel_style", "tensor")
+    mp = getattr(hparams, "model_parallel", 1)
+    if mp > 1 and style != "tensor":
+        raise ValueError(
+            f"--serve supports the tensor parallel style only (got "
+            f"--parallel-style {style} with --model-parallel {mp})"
+        )
+    compute = "bf16" if hparams.precision == "bf16" else "fp32"
+    model_kw: dict = {
+        "dtype": jnp.bfloat16 if compute == "bf16" else jnp.float32,
+        "stem": getattr(hparams, "stem", "cifar"),
+    }
+    image_size = getattr(hparams, "image_size", 32) or 32
+    if hparams.model.startswith("vit"):
+        model_kw["image_size"] = image_size
+        if getattr(hparams, "patch_size", 0):
+            model_kw["patch"] = hparams.patch_size
+        model_kw["moe_dispatch"] = getattr(hparams, "moe_dispatch", "auto")
+        model_kw["block_fusion"] = getattr(hparams, "block_fusion", "auto")
+
+    ckpt_path = getattr(hparams, "serve_ckpt", None)
+    if ckpt_path is None:
+        from ..train.checkpoint import find_serving_checkpoint
+
+        found = find_serving_checkpoint(hparams.ckpt_path)
+        if found is None:
+            warnings.warn(
+                f"no checkpoint under {hparams.ckpt_path!r}; serving "
+                "fresh-initialized weights (load-testing mode)",
+                UserWarning,
+            )
+        ckpt_path = found
+
+    return ServeEngine(
+        model_name=hparams.model,
+        model_kw=model_kw,
+        checkpoint_path=ckpt_path,
+        mesh=mesh,
+        model_parallel=mp,
+        num_devices=getattr(hparams, "num_devices", 0),
+        buckets=getattr(hparams, "serve_buckets", DEFAULT_BUCKETS),
+        precision=compute,
+        image_size=image_size,
+    )
+
+
+def serve_main(hparams) -> dict:
+    """The ``--serve`` entry: engine + batcher + load generator + report.
+
+    Artifacts mirror a training run's: one log line per phase via the
+    experiment logger, TB scalars under ``<ckpt-path>/serve-tb``, and the
+    report dict returned (``entry.run`` prints it on process 0).
+    """
+    from pathlib import Path
+
+    import jax
+
+    from ..parallel import is_main_process
+    from ..utils import setup_logger
+
+    if jax.process_count() > 1:
+        # Each process would run its own batcher/load generator with
+        # independently-timed coalescing windows — mismatched bucket
+        # programs across hosts deadlock the sharded executables.  Serving
+        # is single-controller until a cross-host dispatch protocol exists.
+        raise ValueError(
+            "--serve is single-process: run it on one host (a multi-host "
+            "launch would dispatch desynchronized bucket programs)"
+        )
+    logger = setup_logger(None, is_main_process=is_main_process())
+    engine = build_engine(hparams)
+    ck = engine.checkpoint_meta
+    logger.info(
+        f"[serve] model {hparams.model}, mesh {dict(engine.mesh.shape)}, "
+        f"buckets {list(engine.buckets)}, "
+        + (
+            f"checkpoint epoch {ck['epoch']} (acc {ck['acc']:.4f})"
+            if ck
+            else "fresh weights (no checkpoint)"
+        )
+    )
+    engine.warmup()
+    logger.info(
+        f"[serve] warm: {engine.stats()['compiles']} bucket programs compiled"
+    )
+
+    images = request_pool(
+        max(256, engine.max_bucket),
+        image_size=engine.image_size,
+        seed=hparams.seed,
+    )
+    metrics = ServeMetrics()
+    deadline = getattr(hparams, "deadline_ms", 0.0) or None
+    with MicroBatcher(
+        engine,
+        max_wait_ms=hparams.max_wait_ms,
+        queue_limit=hparams.queue_limit,
+        metrics=metrics,
+    ) as batcher:
+        rate = getattr(hparams, "serve_rate", 0.0)
+        if rate > 0:
+            report = open_loop(
+                batcher,
+                images,
+                rate_rps=rate,
+                num_requests=hparams.serve_requests,
+                deadline_ms=deadline,
+                seed=hparams.seed,
+            )
+        else:
+            report = closed_loop(
+                batcher,
+                images,
+                num_requests=hparams.serve_requests,
+                concurrency=hparams.serve_concurrency,
+                deadline_ms=deadline,
+            )
+    metrics.log_summary(logger)
+    report["engine"] = engine.stats()
+    if is_main_process():
+        metrics.write_tensorboard(Path(hparams.ckpt_path) / "serve-tb")
+    return report

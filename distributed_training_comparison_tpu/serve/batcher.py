@@ -1,0 +1,226 @@
+"""Request queue + micro-batcher: coalescing, deadlines, load shedding.
+
+Serving traffic arrives one request at a time; TPU throughput comes in
+batches.  The micro-batcher bridges the two with the standard coalescing
+rule — dispatch when ``max_batch_size`` requests have gathered **or**
+the oldest queued request has waited ``max_wait_ms``, whichever first —
+so light traffic pays at most the window in added latency and heavy
+traffic rides full buckets.
+
+Degradation is graceful and *typed*:
+
+- ``QueueOverflow`` — raised synchronously at ``submit()`` when queue
+  depth has hit ``queue_limit``.  Rejecting at the door bounds queue
+  delay; without a bound, overload turns into unbounded latency for
+  every request (the classic failure mode this class exists to avoid).
+- ``DeadlineExceeded`` — set on a request whose per-request deadline
+  lapsed while it queued; it is dropped *before* wasting device compute
+  on it.
+
+One daemon worker thread owns all device work, pulling coalesced batches
+and distributing per-row logits back through ``ServeFuture``s.  Counters
+flow into ``serve/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .metrics import ServeMetrics
+
+
+class ServeError(Exception):
+    """Base class for typed serving errors."""
+
+
+class QueueOverflow(ServeError):
+    """Load shed: queue depth exceeded the configured bound at submit."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline lapsed before it reached the device."""
+
+
+class BatcherClosed(ServeError):
+    """Submit after close(), or the batcher died with this request queued."""
+
+
+class ServeFuture:
+    """Completion handle for one request (result row or typed error)."""
+
+    __slots__ = ("_event", "_value", "_error", "submit_t", "done_t", "deadline_t")
+
+    def __init__(self, submit_t: float, deadline_t: float | None) -> None:
+        self._event = threading.Event()
+        self._value = None
+        self._error: BaseException | None = None
+        self.submit_t = submit_t
+        self.done_t: float | None = None
+        self.deadline_t = deadline_t
+
+    def set_result(self, value) -> None:
+        self._value = value
+        self.done_t = time.monotonic()
+        self._event.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self._error = err
+        self.done_t = time.monotonic()
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_s(self) -> float | None:
+        return None if self.done_t is None else self.done_t - self.submit_t
+
+
+class MicroBatcher:
+    """Coalesce submitted requests into engine batches.
+
+    ``engine`` needs ``predict_logits(images) -> logits`` and a
+    ``max_bucket`` attribute (``ServeEngine``, or a stub in tests).
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_batch_size: int | None = None,
+        max_wait_ms: float = 2.0,
+        queue_limit: int = 256,
+        metrics: ServeMetrics | None = None,
+    ) -> None:
+        self.engine = engine
+        self.max_batch_size = int(max_batch_size or engine.max_bucket)
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_limit = int(queue_limit)
+        if self.queue_limit < 1:
+            raise ValueError("queue_limit must be >= 1")
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._loop, name="serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, image: np.ndarray, deadline_ms: float | None = None) -> ServeFuture:
+        """Enqueue one request.  Raises ``QueueOverflow`` (typed, load
+        shed) when the queue is at its bound, ``BatcherClosed`` after
+        ``close()``."""
+        now = time.monotonic()
+        deadline_t = now + deadline_ms / 1e3 if deadline_ms else None
+        with self._cond:
+            if self._closed:
+                raise BatcherClosed("submit after close()")
+            if len(self._queue) >= self.queue_limit:
+                self.metrics.record_shed()
+                raise QueueOverflow(
+                    f"queue depth {len(self._queue)} at the configured "
+                    f"limit {self.queue_limit}; request shed"
+                )
+            fut = ServeFuture(now, deadline_t)
+            self._queue.append((np.asarray(image), fut))
+            self._cond.notify()
+        return fut
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # ------------------------------------------------------------- worker
+    def _take_batch(self) -> list | None:
+        """Block for the first request, then coalesce until the batch is
+        full or the window closes.  None = closed and drained."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait(0.1)
+            if not self._queue:
+                return None  # closed and drained
+            # the window is anchored at the OLDEST request's submit time —
+            # a request that already queued behind a slow batch must not
+            # wait another full window on top
+            window_end = self._queue[0][1].submit_t + self.max_wait_s
+            while (
+                len(self._queue) < self.max_batch_size and not self._closed
+            ):
+                remaining = window_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            batch = [
+                self._queue.popleft()
+                for _ in range(min(len(self._queue), self.max_batch_size))
+            ]
+            depth_after = len(self._queue)
+        self.metrics.record_batch(len(batch), depth_after)
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live: list[tuple[np.ndarray, ServeFuture]] = []
+            for image, fut in batch:
+                if fut.deadline_t is not None and now > fut.deadline_t:
+                    self.metrics.record_expired()
+                    fut.set_error(
+                        DeadlineExceeded(
+                            f"deadline lapsed {(now - fut.deadline_t) * 1e3:.1f} ms "
+                            "before dispatch"
+                        )
+                    )
+                else:
+                    live.append((image, fut))
+            if not live:
+                continue
+            try:
+                logits = self.engine.predict_logits(
+                    np.stack([img for img, _ in live])
+                )
+            except Exception as e:  # engine failure → fail the batch, keep serving
+                self.metrics.record_error()
+                for _, fut in live:
+                    fut.set_error(e)
+                continue
+            for (_, fut), row in zip(live, logits):
+                fut.set_result(row)
+                self.metrics.record_request_done(fut.latency_s)
+
+    # -------------------------------------------------------------- close
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting work; by default let queued requests finish."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    _, fut = self._queue.popleft()
+                    fut.set_error(BatcherClosed("batcher closed undrained"))
+            self._cond.notify_all()
+        self._worker.join(timeout)
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

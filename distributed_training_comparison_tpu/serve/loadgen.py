@@ -1,0 +1,158 @@
+"""Load generators: closed-loop concurrency and open-loop Poisson arrivals.
+
+Two canonical shapes of synthetic traffic (the two ends every serving
+paper measures between):
+
+- **Closed loop**: ``concurrency`` clients, each submitting its next
+  request the moment the previous one completes.  Measures saturated
+  throughput — arrival rate adapts to service rate, so the queue never
+  grows and latency is service time plus the coalescing window.
+- **Open loop**: requests arrive on a Poisson process at ``rate_rps``
+  regardless of completions — real user traffic, and the shape that
+  exposes queueing: as offered load approaches capacity the queue (and
+  tail latency) grows without bound, which is exactly what the
+  batcher's ``queue_limit`` shed bound and per-request deadlines exist
+  to cap.  Arrivals are paced on the clock from a seeded RNG, so a
+  run is reproducible.
+
+Both return one report dict (offered/completed/shed/expired, duration,
+throughput, latency percentiles) built from ``serve/metrics.py``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .batcher import DeadlineExceeded, MicroBatcher, QueueOverflow, ServeError
+from .metrics import latency_summary_ms
+
+
+def request_pool(
+    n: int, image_size: int = 32, seed: int = 0
+) -> np.ndarray:
+    """A pool of synthetic uint8 request images the generators cycle over."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(
+        0, 256, size=(n, image_size, image_size, 3), dtype=np.uint8
+    )
+
+
+def _collect(futures, offered: int, t0: float) -> dict:
+    """Wait out in-flight futures and aggregate the run's report."""
+    latencies, completed, expired, failed = [], 0, 0, 0
+    for fut in futures:
+        try:
+            fut.result(timeout=60.0)
+            completed += 1
+            latencies.append(fut.latency_s)
+        except DeadlineExceeded:
+            expired += 1
+        except (ServeError, TimeoutError):
+            # TimeoutError: still in flight after 60 s (hung engine or an
+            # enormous backlog) — count it failed, keep the report
+            failed += 1
+    duration = max(time.monotonic() - t0, 1e-9)
+    shed = offered - len(futures)
+    return {
+        "offered": offered,
+        "completed": completed,
+        "shed": shed,
+        "expired": expired,
+        "failed": failed,
+        "duration_s": round(duration, 3),
+        "throughput_rps": round(completed / duration, 2),
+        "latency_ms": latency_summary_ms(latencies),
+    }
+
+
+def closed_loop(
+    batcher: MicroBatcher,
+    images: np.ndarray,
+    *,
+    num_requests: int = 256,
+    concurrency: int = 8,
+    deadline_ms: float | None = None,
+) -> dict:
+    """``concurrency`` clients, back-to-back requests, ``num_requests`` total."""
+    t0 = time.monotonic()
+    counter = {"next": 0}
+    counter_lock = threading.Lock()
+    futures: list = []
+    futures_lock = threading.Lock()
+
+    def client() -> None:
+        while True:
+            with counter_lock:
+                i = counter["next"]
+                if i >= num_requests:
+                    return
+                counter["next"] = i + 1
+            try:
+                fut = batcher.submit(
+                    images[i % len(images)], deadline_ms=deadline_ms
+                )
+            except QueueOverflow:
+                continue  # shed; counted by offered - len(futures)
+            with futures_lock:
+                futures.append(fut)
+            try:
+                fut.result(timeout=60.0)
+            except (ServeError, TimeoutError):
+                pass  # tallied in _collect
+
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(max(1, concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    report = _collect(futures, num_requests, t0)
+    report["mode"] = "closed"
+    report["concurrency"] = concurrency
+    return report
+
+
+def open_loop(
+    batcher: MicroBatcher,
+    images: np.ndarray,
+    *,
+    rate_rps: float,
+    num_requests: int = 256,
+    deadline_ms: float | None = None,
+    seed: int = 0,
+) -> dict:
+    """Poisson arrivals at ``rate_rps``, ``num_requests`` offered total.
+
+    Submission is paced on the wall clock from pre-drawn exponential
+    gaps; a shed (``QueueOverflow``) does not pause the arrival process —
+    that is the open-loop property.
+    """
+    if rate_rps <= 0:
+        raise ValueError(f"open loop needs rate_rps > 0, got {rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=num_requests)
+    t0 = time.monotonic()
+    futures: list = []
+    next_t = t0
+    for i in range(num_requests):
+        next_t += gaps[i]
+        delay = next_t - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append(
+                batcher.submit(
+                    images[i % len(images)], deadline_ms=deadline_ms
+                )
+            )
+        except QueueOverflow:
+            pass  # shed; the arrival clock keeps running
+    report = _collect(futures, num_requests, t0)
+    report["mode"] = "open"
+    report["offered_rps"] = round(rate_rps, 2)
+    return report

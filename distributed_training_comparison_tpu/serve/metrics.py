@@ -1,0 +1,134 @@
+"""Serving-side observability: latency percentiles, throughput, queue
+depth, shed counts.
+
+The training side already owns a logger (``utils/logging.py``) and a
+dependency-free TensorBoard event writer (``utils/tensorboard.py``); this
+module aggregates the serving path's per-request/per-batch signals and
+writes them through those same sinks, so a serving run's artifacts look
+like a training run's (log lines + TB scalars under one directory).
+
+All recording methods are called from the micro-batcher's worker thread
+and the load generators' submitter threads concurrently; a single lock
+guards the counters (the hot path appends one float per request — the
+lock is not a bottleneck at the request rates one host can offer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def latency_summary_ms(latencies_s) -> dict[str, float]:
+    """p50/p95/p99/mean/max of a latency sample, in milliseconds."""
+    if not len(latencies_s):
+        return {"p50": 0.0, "p95": 0.0, "p99": 0.0, "mean": 0.0, "max": 0.0}
+    ms = np.asarray(latencies_s, np.float64) * 1e3
+    p50, p95, p99 = np.percentile(ms, [50.0, 95.0, 99.0])
+    return {
+        "p50": round(float(p50), 3),
+        "p95": round(float(p95), 3),
+        "p99": round(float(p99), 3),
+        "mean": round(float(ms.mean()), 3),
+        "max": round(float(ms.max()), 3),
+    }
+
+
+class ServeMetrics:
+    """Counters + samples for one serving session."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.latencies_s: list[float] = []
+        self.batch_sizes: list[int] = []
+        self.queue_depths: list[int] = []
+        self.completed = 0
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+
+    # ------------------------------------------------------------ record
+    def record_request_done(self, latency_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.latencies_s.append(float(latency_s))
+
+    def record_batch(self, batch_size: int, queue_depth: int) -> None:
+        with self._lock:
+            self.batch_sizes.append(int(batch_size))
+            self.queue_depths.append(int(queue_depth))
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_expired(self) -> None:
+        with self._lock:
+            self.expired += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    # ----------------------------------------------------------- report
+    def summary(self) -> dict:
+        """One dict with everything a serving report needs."""
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            lat = latency_summary_ms(self.latencies_s)
+            batches = np.asarray(self.batch_sizes, np.float64)
+            depths = np.asarray(self.queue_depths, np.float64)
+            return {
+                "completed": self.completed,
+                "shed": self.shed,
+                "expired": self.expired,
+                "errors": self.errors,
+                "duration_s": round(elapsed, 3),
+                "throughput_rps": round(self.completed / elapsed, 2),
+                "latency_ms": lat,
+                "batches": len(self.batch_sizes),
+                "mean_batch_size": (
+                    round(float(batches.mean()), 2) if len(batches) else 0.0
+                ),
+                "mean_queue_depth": (
+                    round(float(depths.mean()), 2) if len(depths) else 0.0
+                ),
+                "max_queue_depth": (
+                    int(depths.max()) if len(depths) else 0
+                ),
+            }
+
+    def log_summary(self, logger, prefix: str = "serve") -> dict:
+        """Emit the summary as one log line via the experiment logger."""
+        s = self.summary()
+        lat = s["latency_ms"]
+        logger.info(
+            f"[{prefix}] {s['completed']} ok / {s['shed']} shed / "
+            f"{s['expired']} expired in {s['duration_s']:.1f}s "
+            f"({s['throughput_rps']:.1f} req/s), latency ms "
+            f"p50 {lat['p50']:.2f} p95 {lat['p95']:.2f} p99 {lat['p99']:.2f}, "
+            f"mean batch {s['mean_batch_size']:.1f}, "
+            f"mean queue {s['mean_queue_depth']:.1f}"
+        )
+        return s
+
+    def write_tensorboard(self, log_dir: str | Path, step: int = 0) -> None:
+        """Write the summary as TB scalars through the framework's own
+        event writer (``utils/tensorboard.py``) — readable by any stock
+        TensorBoard next to the training curves."""
+        from ..utils.tensorboard import SummaryWriter
+
+        s = self.summary()
+        with SummaryWriter(log_dir) as w:
+            for k in ("p50", "p95", "p99", "mean"):
+                w.add_scalar(f"serve/latency_{k}_ms", s["latency_ms"][k], step)
+            w.add_scalar("serve/throughput_rps", s["throughput_rps"], step)
+            w.add_scalar("serve/completed", s["completed"], step)
+            w.add_scalar("serve/shed", s["shed"], step)
+            w.add_scalar("serve/expired", s["expired"], step)
+            w.add_scalar("serve/mean_batch_size", s["mean_batch_size"], step)
+            w.add_scalar("serve/mean_queue_depth", s["mean_queue_depth"], step)

@@ -20,8 +20,10 @@ from .step import make_train_step, make_eval_step, make_eval_runner, make_epoch_
 from .async_ckpt import AsyncCheckpointer
 from .checkpoint import (
     find_version_dir,
+    find_serving_checkpoint,
     save_checkpoint,
     load_checkpoint,
+    load_eval_variables,
     save_resume_state,
     load_resume_state,
 )
@@ -39,8 +41,10 @@ __all__ = [
     "make_epoch_runner",
     "AsyncCheckpointer",
     "find_version_dir",
+    "find_serving_checkpoint",
     "save_checkpoint",
     "load_checkpoint",
+    "load_eval_variables",
     "save_resume_state",
     "load_resume_state",
     "Trainer",

@@ -115,6 +115,18 @@ def load_checkpoint(path: str | Path, state: TrainState) -> TrainState:
     return state.replace(params=params, batch_stats=batch_stats)
 
 
+def _version_dirs_newest_first(ckpt_root: str | Path) -> list[Path]:
+    """``version-{n}`` dirs under ``ckpt_root``, numerically newest first —
+    the one discovery rule --auto-resume and the serve engine share (so
+    both always agree on which run is 'newest')."""
+    dirs = [
+        d
+        for d in Path(ckpt_root).glob("version-*")
+        if d.name.split("-")[-1].isdigit()
+    ]
+    return sorted(dirs, key=lambda d: -int(d.name.split("-")[-1]))
+
+
 def find_latest_resume(ckpt_root: str | Path) -> Path | None:
     """The NEWEST version dir's ``last.ckpt``, or None.
 
@@ -124,14 +136,10 @@ def find_latest_resume(ckpt_root: str | Path) -> Path | None:
     version is considered — if it crashed before its first save (or ran
     with --no-save-last), auto-resume starts fresh rather than silently
     resuming into an older, possibly completed run's directory."""
-    root = Path(ckpt_root)
-    dirs = [
-        d for d in root.glob("version-*") if d.name.split("-")[-1].isdigit()
-    ]
+    dirs = _version_dirs_newest_first(ckpt_root)
     if not dirs:
         return None
-    newest = max(dirs, key=lambda d: int(d.name.split("-")[-1]))
-    path = newest / LAST_NAME
+    path = dirs[0] / LAST_NAME
     return path if path.exists() else None
 
 
@@ -177,6 +185,50 @@ def find_best_checkpoint(version_dir: str | Path, cleanup: bool = False) -> Path
             if _best_sort_key(stale) != (-1, -1.0):
                 stale.unlink(missing_ok=True)
     return best
+
+
+def load_eval_variables(path: str | Path, variables: dict) -> tuple[dict, dict]:
+    """Restore ``{"params", "batch_stats"}`` from a checkpoint into a
+    ``model.init``-shaped variables template — the inference-side loader
+    (serve engine, eval tools): no ``TrainState``/optimizer needed.
+
+    Accepts either payload format: a best checkpoint (params + stats at
+    the top level) or a resumable ``last.ckpt`` (full state nested under
+    ``"state"`` — the optimizer leaves are simply ignored).  Returns the
+    restored variables and a metadata dict (epoch + the accuracy field
+    the file carries).
+    """
+    raw = serialization.msgpack_restore(Path(path).read_bytes())
+    _check_ckpt_fmt(raw, variables.get("params", {}), path)
+    if "state" in raw:  # last.ckpt layout
+        src = raw["state"]
+        acc = float(raw.get("best_acc", 0.0))
+    else:  # best_model_* layout
+        src = raw
+        acc = float(raw.get("val_acc", 0.0))
+    restored = {
+        "params": serialization.from_state_dict(
+            variables["params"], src["params"]
+        ),
+        "batch_stats": serialization.from_state_dict(
+            variables.get("batch_stats", {}), src["batch_stats"]
+        ),
+    }
+    return restored, {"epoch": int(raw.get("epoch", -1)), "acc": acc}
+
+
+def find_serving_checkpoint(ckpt_root: str | Path) -> Path | None:
+    """Newest version dir's best checkpoint (falling back to its
+    ``last.ckpt``) — the serve engine's default discovery, scanning the
+    same ``version-{n}`` layout training writes."""
+    for d in _version_dirs_newest_first(ckpt_root):
+        best = find_best_checkpoint(d)
+        if best is not None:
+            return best
+        last = d / LAST_NAME
+        if last.exists():
+            return last
+    return None
 
 
 def save_resume_state(

@@ -108,6 +108,7 @@ class Trainer:
             stem=getattr(hparams, "stem", "cifar"),
             remat=getattr(hparams, "remat", False),
         )
+        expert_parallel = False
         if hparams.model.startswith("vit"):
             # the ViT sizes its position embedding in setup(); the ResNet
             # family is resolution-agnostic and takes no such field
@@ -122,23 +123,16 @@ class Trainer:
             if unroll == 0:
                 unroll = -1 if jax.default_backend() == "tpu" else 1
             model_kw["scan_unroll"] = unroll
-            # "auto" resolves to the Pallas grouped-matmul dispatch on a
-            # TPU backend (models/moe.py) — except under expert
-            # parallelism, where GSPMD must shard the expert computation
-            # and only the XLA sort/gather formulation partitions
-            dispatch = getattr(hparams, "moe_dispatch", "auto")
-            is_moe = hparams.model == "vit_moe"
-            if is_moe and getattr(hparams, "model_parallel", 1) > 1:
-                if dispatch == "gmm":
-                    raise ValueError(
-                        "--moe-dispatch gmm requires unsharded experts: "
-                        "GSPMD cannot partition the Pallas grouped-matmul "
-                        "kernel over the model axis — use 'gather' (or "
-                        "'auto') with --model-parallel > 1"
-                    )
-                if dispatch == "auto":
-                    dispatch = "gather"
-            model_kw["moe_dispatch"] = dispatch
+            # Sharding-aware dispatch resolution is shared with every
+            # other get_model caller (models/moe.py resolve_dispatch):
+            # under expert parallelism GSPMD must shard the expert
+            # computation, and only the XLA sort/gather formulation
+            # partitions — an explicit 'gmm' is a config error there.
+            model_kw["moe_dispatch"] = getattr(hparams, "moe_dispatch", "auto")
+            expert_parallel = (
+                hparams.model == "vit_moe"
+                and getattr(hparams, "model_parallel", 1) > 1
+            )
             # the fused block kernel requires unsharded block params:
             # tensor parallelism shards the projection/MLP kernels and
             # pipeline stages re-drive blocks under shard_map — compose
@@ -160,7 +154,7 @@ class Trainer:
                 fusion = "off"
             model_kw["block_fusion"] = fusion
         self.model = model if model is not None else get_model(
-            hparams.model, **model_kw
+            hparams.model, expert_parallel=expert_parallel, **model_kw
         )
 
         # --- data.  'device' mode: split is HBM-resident and replicated;

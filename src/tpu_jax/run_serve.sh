@@ -1,0 +1,18 @@
+#!/bin/sh
+# Serving harness: restore the newest checkpoint trained by run_tpu.sh and
+# drive the batched inference engine with an open-loop (Poisson) load at
+# RATE req/s.  RATE=0 switches to closed-loop saturation at CONCURRENCY
+# in-flight requests.  Extra flags pass through (e.g. --model vit_tiny,
+# --serve-ckpt PATH, --deadline-ms 50, --serve-buckets 8,16,32,64).
+RATE=${RATE:-256}
+REQUESTS=${REQUESTS:-2048}
+CONCURRENCY=${CONCURRENCY:-8}
+
+python src/tpu_jax/main.py \
+  --serve \
+  --serve-rate ${RATE} \
+  --serve-requests ${REQUESTS} \
+  --serve-concurrency ${CONCURRENCY} \
+  --ckpt-path src/tpu_jax/checkpoints/ \
+  --amp \
+  "$@"

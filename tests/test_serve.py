@@ -1,0 +1,299 @@
+"""Serving subsystem tests on the 8-device CPU mesh (tier-1 fast).
+
+Covers the serve/ contracts end to end: bucket selection + padding
+semantics, the zero-recompile guarantee under ragged open-loop traffic
+(asserted through the engine's own compile/cache counters), typed
+load-shed and deadline errors, checkpoint fidelity (engine logits ==
+the Trainer's restored-best-checkpoint logits, engine accuracy ==
+``Trainer.test``), and the flag surface.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.data import get_datasets
+from distributed_training_comparison_tpu.data.augment import normalize_images
+from distributed_training_comparison_tpu.serve import (
+    BatcherClosed,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+    ServeEngine,
+    ServeError,
+    ServeMetrics,
+    closed_loop,
+    open_loop,
+    request_pool,
+)
+from distributed_training_comparison_tpu.train import Trainer
+from distributed_training_comparison_tpu.train.checkpoint import (
+    find_serving_checkpoint,
+)
+
+from test_train import TinyNet
+
+IMG = 16  # request image edge for the engine-only tests
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = ServeEngine(
+        model=TinyNet(num_classes=10),
+        buckets=(2, 4, 8),
+        precision="fp32",
+        image_size=IMG,
+    )
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def images():
+    return request_pool(64, image_size=IMG, seed=0)
+
+
+# --------------------------------------------------------------- buckets
+
+
+def test_bucket_selection(engine):
+    assert engine.bucket_for(1) == 2
+    assert engine.bucket_for(2) == 2
+    assert engine.bucket_for(3) == 4
+    assert engine.bucket_for(8) == 8
+    with pytest.raises(ValueError, match="largest bucket"):
+        engine.bucket_for(9)
+
+
+def test_predict_chunks_past_max_bucket(engine, images):
+    before = dict(engine.stats()["bucket_counts"])
+    out = engine.predict_logits(images[:19])  # 8 + 8 + 3 → buckets 8,8,4
+    assert out.shape == (19, 10) and out.dtype == np.float32
+    after = engine.stats()["bucket_counts"]
+    assert after[8] - before[8] == 2 and after[4] - before[4] == 1
+
+
+def test_empty_batch_keeps_logits_rank(engine):
+    out = engine.predict_logits(np.zeros((0, IMG, IMG, 3), np.uint8))
+    assert out.shape == (0, 10) and out.dtype == np.float32
+
+
+def test_padding_rows_do_not_change_logits(engine, images):
+    """A size-3 request padded into the 4-bucket must yield the same rows
+    as the same images inside a full bucket (eval-mode per-example
+    independence)."""
+    ragged = engine.predict_logits(images[:3])
+    full = engine.predict_logits(images[:4])
+    np.testing.assert_allclose(ragged, full[:3], rtol=0, atol=1e-6)
+
+
+def test_ragged_traffic_never_recompiles_after_warmup(engine, images):
+    compiles = engine.stats()["compiles"]
+    assert compiles == len(engine.buckets)  # warmup compiled the ladder
+    rng = np.random.default_rng(0)
+    for n in rng.integers(1, 9, size=16):
+        engine.predict_logits(images[: int(n)])
+    stats = engine.stats()
+    assert stats["compiles"] == compiles  # ZERO recompiles on ragged sizes
+    assert stats["cache_hits"] >= 16
+
+
+# ---------------------------------------------------- batcher + shedding
+
+
+class _SlowStubEngine:
+    """Engine stand-in with a controllable service time (no device work)."""
+
+    max_bucket = 8
+
+    def __init__(self, delay_s: float = 0.05):
+        self.delay_s = delay_s
+        self.calls = []
+
+    def predict_logits(self, imgs):
+        time.sleep(self.delay_s)
+        self.calls.append(len(imgs))
+        return np.zeros((len(imgs), 4), np.float32)
+
+
+def test_batcher_coalesces_and_completes():
+    eng = _SlowStubEngine(delay_s=0.01)
+    with MicroBatcher(eng, max_wait_ms=20, queue_limit=32) as b:
+        futs = [b.submit(np.zeros((4, 4, 3), np.uint8)) for _ in range(5)]
+        rows = [f.result(timeout=5) for f in futs]
+    assert all(r.shape == (4,) for r in rows)
+    assert sum(eng.calls) == 5
+    assert max(eng.calls) > 1  # the window actually coalesced requests
+
+
+def test_queue_overflow_is_typed_and_counted():
+    eng = _SlowStubEngine(delay_s=0.2)  # worker busy → queue builds
+    m = ServeMetrics()
+    b = MicroBatcher(eng, max_wait_ms=1, queue_limit=4, metrics=m)
+    try:
+        b.submit(np.zeros((4, 4, 3), np.uint8))  # occupies the worker
+        time.sleep(0.05)
+        with pytest.raises(QueueOverflow) as ei:
+            for _ in range(10):
+                b.submit(np.zeros((4, 4, 3), np.uint8))
+        assert isinstance(ei.value, ServeError)  # typed hierarchy
+        assert m.shed >= 1
+    finally:
+        b.close()
+
+
+def test_deadline_expiry_is_typed():
+    eng = _SlowStubEngine(delay_s=0.15)
+    m = ServeMetrics()
+    b = MicroBatcher(eng, max_wait_ms=1, queue_limit=32, metrics=m)
+    try:
+        blocker = b.submit(np.zeros((4, 4, 3), np.uint8))
+        time.sleep(0.02)  # ensure the blocker's batch dispatched first
+        doomed = b.submit(np.zeros((4, 4, 3), np.uint8), deadline_ms=1.0)
+        blocker.result(timeout=5)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=5)
+        assert m.expired == 1
+    finally:
+        b.close()
+
+
+def test_submit_after_close_raises():
+    b = MicroBatcher(_SlowStubEngine(0.0), max_wait_ms=1, queue_limit=4)
+    b.close()
+    with pytest.raises(BatcherClosed):
+        b.submit(np.zeros((4, 4, 3), np.uint8))
+
+
+# ------------------------------------------------------------- load gens
+
+
+def test_closed_and_open_loop_reports(engine, images):
+    m = ServeMetrics()
+    with MicroBatcher(engine, max_wait_ms=5, queue_limit=64, metrics=m) as b:
+        closed = closed_loop(b, images, num_requests=24, concurrency=4)
+        compiles = engine.stats()["compiles"]
+        opened = open_loop(b, images, rate_rps=400.0, num_requests=24, seed=1)
+    for rep in (closed, opened):
+        assert rep["offered"] == 24
+        assert rep["completed"] + rep["shed"] + rep["expired"] + rep["failed"] == 24
+        assert rep["completed"] > 0
+        assert rep["latency_ms"]["p50"] <= rep["latency_ms"]["p99"]
+    # the acceptance contract: ragged open-loop traffic, zero recompiles
+    assert engine.stats()["compiles"] == compiles
+    s = m.summary()
+    assert s["completed"] == closed["completed"] + opened["completed"]
+    assert s["mean_batch_size"] >= 1.0
+
+
+def test_metrics_tensorboard_roundtrip(tmp_path):
+    m = ServeMetrics()
+    m.record_request_done(0.010)
+    m.record_request_done(0.020)
+    m.record_batch(2, 0)
+    m.record_shed()
+    m.write_tensorboard(tmp_path)
+    assert list(tmp_path.glob("events.out.tfevents.*"))
+    s = m.summary()
+    assert s["completed"] == 2 and s["shed"] == 1
+    assert 10.0 <= s["latency_ms"]["p50"] <= 20.0
+
+
+# ------------------------------------------- checkpoint fidelity (e2e)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One tiny fit() whose best checkpoint the engine serves."""
+    tmp = tmp_path_factory.mktemp("serve_ckpt")
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "256",
+            "--batch-size", "64", "--epoch", "1", "--eval-step", "2",
+            "--lr", "0.05", "--ckpt-path", str(tmp),
+        ],
+    )
+    trainer = Trainer(hp, model=TinyNet(num_classes=100))
+    trainer.fit()
+    results = trainer.test()  # loads the best checkpoint into trainer.state
+    return hp, trainer, results, tmp
+
+
+def test_engine_matches_trainer_on_restored_checkpoint(trained):
+    hp, trainer, results, tmp = trained
+    ckpt_path = find_serving_checkpoint(tmp)
+    assert ckpt_path is not None and ckpt_path.name.startswith("best_model_")
+    engine = ServeEngine(
+        model=TinyNet(num_classes=100),
+        checkpoint_path=ckpt_path,
+        buckets=(64,),
+        precision="fp32",
+        image_size=32,
+    )
+    assert engine.checkpoint_meta is not None
+
+    _, _, tst = get_datasets(hp)
+    batch = tst.images[:64]
+    got = engine.predict_logits(batch)
+    want = np.asarray(
+        trainer.state.apply_fn(
+            {
+                "params": trainer.state.params,
+                "batch_stats": trainer.state.batch_stats,
+            },
+            normalize_images(batch),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    # whole-split accuracy through the engine == Trainer.test's top-1
+    logits = engine.predict_logits(tst.images)
+    top1 = 100.0 * float(
+        np.mean(np.argmax(logits, axis=-1) == tst.labels)
+    )
+    assert abs(top1 - results["test_top1"]) < 1e-3
+    trainer.close()
+
+
+def test_engine_serves_last_ckpt_too(trained):
+    """load_eval_variables accepts the resumable last.ckpt layout."""
+    hp, _, _, tmp = trained
+    last = next(tmp.glob("version-*/last.ckpt"))
+    engine = ServeEngine(
+        model=TinyNet(num_classes=100),
+        checkpoint_path=last,
+        buckets=(8,),
+        precision="fp32",
+        image_size=32,
+    )
+    out = engine.predict_logits(np.zeros((3, 32, 32, 3), np.uint8))
+    assert out.shape == (3, 100) and np.isfinite(out).all()
+
+
+# ---------------------------------------------------------- flag surface
+
+
+def test_serve_flags_parse():
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--serve", "--serve-buckets", "8,1,4,4",
+            "--max-wait-ms", "3.5", "--queue-limit", "7",
+            "--serve-rate", "100",
+        ],
+    )
+    assert hp.serve is True
+    assert hp.serve_buckets == (1, 4, 8)  # sorted, deduped
+    assert hp.max_wait_ms == 3.5 and hp.queue_limit == 7
+
+
+def test_serve_buckets_validation():
+    with pytest.raises(SystemExit):
+        load_config("tpu", argv=["--serve-buckets", "0,4"])
+    with pytest.raises(SystemExit):
+        load_config("tpu", argv=["--serve-buckets", "a,b"])
