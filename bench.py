@@ -709,6 +709,101 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
     return record
 
 
+def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
+    """The resilience leg: a real supervised training run through the fault
+    gauntlet — injected preemption at epoch 1, supervisor relaunch with
+    ``--auto-resume`` (on CPU: onto a DIFFERENT forced device count — the
+    elastic path), goodput aggregated across the attempts into
+    ``GOODPUT.json`` (pretty-print with ``tools/goodput_report.py``).
+
+    Children are separate processes (the per-attempt device-count flag must
+    land before jax initializes), launched through the real
+    ``src/tpu_jax/main.py`` entry so the measured recovery cost includes
+    everything a production relaunch pays: process start, imports, compile
+    (persistent cache), restore.
+    """
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from distributed_training_comparison_tpu.resilience import Supervisor
+    from distributed_training_comparison_tpu.resilience.goodput import (
+        aggregate_goodput,
+        collect_goodput_records,
+        write_goodput,
+    )
+
+    platform = jax.devices()[0].platform
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ckpt_root = tempfile.mkdtemp(prefix="resilience-bench-")
+    if platform == "cpu":  # CI smoke sizing (this container: ONE cpu core —
+        # small forced meshes keep the per-child XLA compile tractable)
+        size_args = ["--limit-examples", "128", "--batch-size", "64", "--epoch", "3"]
+        device_counts = {0: 2, 1: 1}  # preempted on 2 devices, resumed on 1 (elastic)
+    else:
+        size_args = ["--limit-examples", "4096", "--batch-size", "256", "--epoch", "6"]
+        device_counts = {}
+
+    cmd = [
+        sys.executable, os.path.join(repo, "src", "tpu_jax", "main.py"),
+        "--synthetic-data", *size_args,
+        "--ckpt-path", ckpt_root,
+        "--save-last-min-secs", "0", "--no-progress",
+        "--resilience", "--auto-resume",
+        "--fault-plan", "preempt@epoch=1",
+    ]
+
+    def env_for(attempt: int) -> dict:
+        if not device_counts:
+            return dict(os.environ)
+        from distributed_training_comparison_tpu.resilience.elastic import (
+            forced_host_device_env,
+        )
+
+        return forced_host_device_env(
+            device_counts.get(attempt, device_counts[max(device_counts)])
+        )
+
+    def runner(c, env):
+        proc = subprocess.run(list(c), env=env, capture_output=True, text=True)
+        emit_progress(
+            "resilience_attempt",
+            {"rc": proc.returncode, "tail": (proc.stdout or "")[-300:]},
+        )
+        return proc.returncode
+
+    summary = Supervisor(
+        cmd, env=env_for, max_restarts=3, backoff_base=0.2, runner=runner
+    ).run()
+    # every version dir, not a hardcoded version-0: an attempt that died
+    # before its first save leaves its goodput record in one dir while the
+    # relaunch progresses in the next — both belong in the aggregate
+    records = collect_goodput_records(ckpt_root)
+    record = aggregate_goodput(
+        records,
+        downtime_s=summary["downtime_s"],
+        restarts=summary["restarts"],
+        preemptions=summary["preemptions"],
+    )
+    record["supervisor"] = summary
+    record["platform"] = platform
+    write_goodput(out_path, record)
+    print(json.dumps({
+        "metric": record["metric"],
+        "goodput_frac": record["goodput_frac"],
+        "productive_s": record["productive_s"],
+        "total_wall_s": record["total_wall_s"],
+        "restarts": record["restarts"],
+        "preemptions": record["preemptions"],
+        "attempts": record["attempts"],
+        "final_rc": summary["final_rc"],
+        "platform": platform,
+        "full_record": out_path,
+    }))
+    return record
+
+
 def smoke() -> None:
     """Compile + run one vit_long train step at its design point (4096
     tokens, D=128, batch 8 @ 256px) — the commit-time check that catches a
@@ -760,5 +855,7 @@ if __name__ == "__main__":
         smoke()
     elif "--serve" in sys.argv:
         bench_serve()
+    elif "--resilience" in sys.argv:
+        bench_resilience()
     else:
         main()

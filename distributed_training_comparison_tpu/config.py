@@ -416,6 +416,67 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Per-request deadline; expired requests are failed with a "
         "typed DeadlineExceeded error before wasting compute (0 = none)",
     )
+    # resilience (resilience/ subsystem: faults + preemption + supervisor +
+    # crash-safe checkpoint I/O + elastic restore + goodput accounting)
+    parser.add_argument(
+        "--resilience",
+        action="store_true",
+        default=False,
+        help="Preemption-aware mode: install the SIGTERM handler (drain "
+        "the async checkpointer, force a final last.ckpt, exit with the "
+        "distinct EXIT_PREEMPTED code the supervisor restarts on). "
+        "Goodput accounting always runs; this flag adds the signal "
+        "machinery (resilience/)",
+    )
+    parser.add_argument(
+        "--supervise",
+        action="store_true",
+        default=False,
+        help="Run the restart supervisor instead of training directly: "
+        "relaunch this same command (with --auto-resume --resilience) "
+        "until clean exit, restarting immediately on preemption and with "
+        "exponential backoff on crashes, up to --max-restarts; aggregates "
+        "goodput across attempts into GOODPUT.json. CLI-only",
+    )
+    parser.add_argument(
+        "--max-restarts",
+        type=int,
+        default=3,
+        help="Supervisor restart budget (crashes and preemptions both "
+        "count toward it; preemptions skip the backoff)",
+    )
+    parser.add_argument(
+        "--restart-backoff",
+        type=float,
+        default=1.0,
+        help="Base seconds for the supervisor's exponential crash backoff "
+        "(doubles per crash, capped at 60s)",
+    )
+    parser.add_argument(
+        "--fault-plan",
+        type=str,
+        default=None,
+        help="Deterministic fault-injection spec, ';'-separated events: "
+        "preempt@epoch=K, ckpt_fail@epoch=K, torn_write@epoch=K, "
+        "stall@epoch=K:secs=S, or kind@prob=P (seeded per-epoch "
+        "Bernoulli). Fires at epoch boundaries; epoch=K events are "
+        "naturally one-shot across supervised restarts (resume moves past "
+        "K). See resilience/faults.py",
+    )
+    parser.add_argument(
+        "--fault-seed",
+        type=int,
+        default=0,
+        help="Seed for prob= fault-plan draws (deterministic per "
+        "(seed, kind, epoch))",
+    )
+    parser.add_argument(
+        "--goodput-json",
+        type=str,
+        default=None,
+        help="Also write the aggregated goodput report to this path at the "
+        "end of the run (the supervisor always writes GOODPUT.json)",
+    )
     parser.add_argument(
         "--legacy-test-stats",
         action="store_true",
@@ -436,6 +497,19 @@ def load_config(
     args.backend = backend
     if args.limit_examples < 0:
         parser.error(f"--limit-examples must be >= 0, got {args.limit_examples}")
+    if args.max_restarts < 0:
+        parser.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
+    if args.restart_backoff < 0:
+        parser.error(f"--restart-backoff must be >= 0, got {args.restart_backoff}")
+    if args.fault_plan:
+        # a malformed fault plan must die at the CLI, not at epoch 0 of a
+        # run that already burned its startup/compile time
+        from .resilience.faults import FaultPlan, FaultSpecError
+
+        try:
+            FaultPlan.parse(args.fault_plan, seed=args.fault_seed)
+        except FaultSpecError as e:
+            parser.error(str(e))
     if args.precision is None:
         args.precision = "bf16" if args.amp else "fp32"
     try:

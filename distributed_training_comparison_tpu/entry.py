@@ -30,8 +30,27 @@ def run(backend: str, argv: Sequence[str] | None = None) -> dict:
     checkpoint this same entry trained, compile the bucketed predict
     programs, and drive them with the configured load generator
     (``serve/``; launcher ``src/tpu_jax/run_serve.sh``).
+
+    ``--supervise`` routes to the resilience supervisor: relaunch this same
+    command as a child process (with ``--auto-resume --resilience``) under
+    the restart policy, aggregating goodput across attempts
+    (``resilience/``; launcher ``src/tpu_jax/run_resilient.sh``).
+
+    A preempted run (SIGTERM or injected fault) drains its checkpoints and
+    returns ``exit_code=EXIT_PREEMPTED`` in the results; the backend
+    ``main.py`` scripts exit with it so a supervisor can tell preemption
+    from crash.
     """
     hparams = load_config(backend, argv)
+
+    if getattr(hparams, "supervise", False):
+        # parent loop: never touches accelerators (the children do)
+        from .resilience.supervisor import run_supervised
+
+        results = run_supervised(hparams, argv)
+        print(results)
+        return results
+
     enable_persistent_compilation_cache()
     init_distributed(hparams)
 
@@ -43,15 +62,27 @@ def run(backend: str, argv: Sequence[str] | None = None) -> dict:
             print(results)
         return results
 
+    from .resilience import EXIT_PREEMPTED, Preempted
+
     trainer = Trainer(hparams)
     results: dict = {}
     try:
-        results["version"] = trainer.fit()
-        if hparams.contain_test:
-            # Test on the best checkpoint of the run we just trained —
-            # process-0 metrics are already global (every example counted
-            # once; unlike the reference's rank-0-tests-its-own-shard quirk).
-            results.update(trainer.test())
+        try:
+            results["version"] = trainer.fit()
+        except Preempted as e:
+            results.update(
+                version=trainer.version,
+                preempted=True,
+                epoch=e.epoch,
+                exit_code=EXIT_PREEMPTED,
+            )
+        else:
+            if hparams.contain_test:
+                # Test on the best checkpoint of the run we just trained —
+                # process-0 metrics are already global (every example
+                # counted once; unlike the reference's
+                # rank-0-tests-its-own-shard quirk).
+                results.update(trainer.test())
     finally:
         trainer.close()
     if is_main_process():

@@ -1,0 +1,62 @@
+"""Resilience: fault injection, preemption handling, crash-safe checkpoint
+I/O, elastic restore, restart supervision, and goodput accounting.
+
+The reference repo saves only model weights — a killed run cannot resume
+(SURVEY.md §5), and nothing in it ever *exercises* a failure.  A production
+system spends real wall-clock in preemptions and restarts, so this package
+makes failure a first-class, testable code path:
+
+- ``faults``     — deterministic, seeded fault-injection harness (preemption
+                   signals, checkpoint-write failures, torn writes, stalls)
+                   driven by a ``--fault-plan`` spec;
+- ``preempt``    — SIGTERM/injected-preemption handler: drain the async
+                   checkpointer, write a final ``last.ckpt``, exit with a
+                   distinct code the supervisor recognizes as transient;
+- ``ckpt_io``    — atomic tmp+fsync+rename writes, a sidecar integrity
+                   manifest (payload checksum, step, mesh shape), and
+                   verify-on-restore with previous-good rotation;
+- ``supervisor`` — restart loop with exponential backoff + max-restart
+                   budget, resuming from the newest *valid* checkpoint;
+- ``elastic``    — restoring onto a different device count / mesh shape
+                   than the state was saved under;
+- ``goodput``    — productive step time vs. checkpoint / restart / recovery
+                   time, aggregated across restarts into ``GOODPUT.json``.
+"""
+
+from .ckpt_io import (
+    atomic_write_bytes,
+    manifest_path,
+    previous_path,
+    read_manifest,
+    rotate_previous,
+    verify_checkpoint,
+    write_manifest,
+)
+from .elastic import describe_restore, forced_host_device_env, topology
+from .faults import FaultEvent, FaultPlan, FaultSpecError
+from .goodput import GoodputMeter, aggregate_goodput, load_goodput_records
+from .preempt import EXIT_PREEMPTED, Preempted, PreemptionHandler
+from .supervisor import Supervisor
+
+__all__ = [
+    "atomic_write_bytes",
+    "manifest_path",
+    "previous_path",
+    "read_manifest",
+    "rotate_previous",
+    "verify_checkpoint",
+    "write_manifest",
+    "describe_restore",
+    "forced_host_device_env",
+    "topology",
+    "FaultEvent",
+    "FaultPlan",
+    "FaultSpecError",
+    "GoodputMeter",
+    "aggregate_goodput",
+    "load_goodput_records",
+    "EXIT_PREEMPTED",
+    "Preempted",
+    "PreemptionHandler",
+    "Supervisor",
+]

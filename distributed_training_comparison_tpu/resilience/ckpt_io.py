@@ -1,0 +1,164 @@
+"""Crash-safe checkpoint I/O: atomic writes, integrity manifests, rotation.
+
+The pre-existing ``tmp.replace(path)`` save was atomic against a crash of
+*this* process but still trusted the file's bytes: a torn write below the
+rename (power loss, full disk returning short writes, a copy truncated by a
+dying NFS client) produced a ``last.ckpt`` that parses partway and then
+kills the restarted run — the worst failure mode, because it defeats the
+resume machinery exactly when it is needed.
+
+Three mechanisms close that hole:
+
+- ``atomic_write_bytes`` — tmp file + ``flush`` + ``fsync`` + ``os.replace``
+  + directory fsync, so the rename itself is durable, not just ordered;
+- a sidecar **manifest** (``<name>.manifest.json``) carrying the payload's
+  SHA-256, byte count, and train-state metadata (step, epoch, mesh shape);
+  written *after* the payload so a crash between the two leaves a stale
+  manifest that fails verification (never a fresh manifest blessing torn
+  bytes);
+- **rotation**: before a new ``last.ckpt`` lands, the previous verified one
+  is renamed to ``prev-last.ckpt`` — restore falls back to it when the
+  newest file fails its manifest check.
+
+Checkpoints written before this module existed have no manifest;
+``verify_checkpoint`` accepts them (legacy mode) so old run dirs keep
+resuming.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+
+MANIFEST_SUFFIX = ".manifest.json"
+PREV_PREFIX = "prev-"
+
+
+def manifest_path(path: str | Path) -> Path:
+    path = Path(path)
+    return path.with_name(path.name + MANIFEST_SUFFIX)
+
+
+def previous_path(path: str | Path) -> Path:
+    """The rotation target for ``path`` (``last.ckpt`` → ``prev-last.ckpt``)."""
+    path = Path(path)
+    return path.with_name(PREV_PREFIX + path.name)
+
+
+def _fsync_dir(directory: Path) -> None:
+    """Make a rename in ``directory`` durable (POSIX: the rename is only on
+    disk once the directory inode is)."""
+    try:
+        fd = os.open(directory, os.O_RDONLY)
+    except OSError:  # e.g. O_RDONLY on a dir unsupported (some platforms)
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str | Path, data: bytes, durable: bool = True) -> Path:
+    """Write ``data`` to ``path`` via tmp+fsync+rename: readers never observe
+    a partial file, and after return the content survives power loss."""
+    path = Path(path)
+    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if durable:
+        _fsync_dir(path.parent)
+    return path
+
+
+def write_manifest(path: str | Path, data: bytes, meta: dict | None = None) -> Path:
+    """Write the sidecar integrity manifest for a payload already at
+    ``path`` whose bytes are ``data``.  Call AFTER the payload write: the
+    crash window then holds a stale manifest (checksum mismatch → fallback),
+    never a fresh manifest over torn bytes."""
+    record = {
+        "sha256": hashlib.sha256(data).hexdigest(),
+        "bytes": len(data),
+        **(meta or {}),
+    }
+    return atomic_write_bytes(
+        manifest_path(path), json.dumps(record, indent=1).encode()
+    )
+
+
+def read_manifest(path: str | Path) -> dict | None:
+    """The manifest dict for checkpoint ``path``, or None (missing/corrupt)."""
+    mpath = manifest_path(path)
+    try:
+        return json.loads(mpath.read_bytes())
+    except (OSError, ValueError):
+        return None
+
+
+def verify_checkpoint(
+    path: str | Path, deep: bool = True, data: bytes | None = None
+) -> tuple[bool, str]:
+    """``(ok, reason)`` for the payload at ``path`` against its manifest.
+
+    ``deep=False`` skips the checksum (size-only) — the cheap pre-rotation
+    check, so each epoch's save does not re-hash the previous multi-GB file.
+    ``data`` lets a caller that has already read the payload (to restore
+    it) verify that buffer instead of paying a second full-file read.
+    A checkpoint without a manifest is accepted as legacy (pre-manifest run
+    dirs must keep resuming); its parseability is the loader's problem.
+    """
+    path = Path(path)
+    if not path.exists():
+        return False, "missing"
+    manifest = read_manifest(path)
+    if manifest is None:
+        # Absent manifest = legacy checkpoint, accepted.  A manifest that
+        # EXISTS but does not parse is corruption in the same event that
+        # may have torn the payload — rejecting it sends restore to the
+        # verified prev- fallback instead of trusting unverifiable bytes
+        # (and keeps rotate_previous from evicting the good prev copy).
+        if manifest_path(path).exists():
+            return False, "manifest present but unreadable (corrupted)"
+        return True, "no manifest (legacy checkpoint, accepted unverified)"
+    size = len(data) if data is not None else path.stat().st_size
+    if size != manifest.get("bytes"):
+        return False, f"size mismatch: {size} on disk vs {manifest.get('bytes')} in manifest"
+    if deep:
+        digest = hashlib.sha256(
+            data if data is not None else path.read_bytes()
+        ).hexdigest()
+        if digest != manifest.get("sha256"):
+            return False, "checksum mismatch (torn or corrupted write)"
+    return True, "verified"
+
+
+def rotate_previous(path: str | Path) -> Path | None:
+    """Rename an existing (size-valid) ``path`` + manifest to the ``prev-``
+    slot, making room for a new write while keeping one good fallback.
+
+    A size-invalid current file is NOT rotated — it would evict a good
+    ``prev-`` checkpoint in favor of known-torn bytes.  Returns the rotated
+    path, or None if nothing was rotated.
+    """
+    path = Path(path)
+    if not path.exists():
+        return None
+    ok, _ = verify_checkpoint(path, deep=False)
+    if not ok:
+        return None
+    prev = previous_path(path)
+    os.replace(path, prev)
+    mpath = manifest_path(path)
+    prev_manifest = manifest_path(prev)
+    if mpath.exists():
+        os.replace(mpath, prev_manifest)
+    else:  # legacy current had no manifest: drop any stale prev manifest
+        prev_manifest.unlink(missing_ok=True)
+    return prev

@@ -1,0 +1,94 @@
+"""Elastic restore: the device topology is a run-time variable.
+
+A preempted run often comes back on a different slice — fewer hosts, a
+different local device count, a resized mesh.  The checkpoint format was
+chosen to make this cheap: ``last.ckpt`` holds *host* numpy pytrees (no
+device-layout coupling, unlike sharded per-device checkpoint formats), so
+restore-on-a-new-mesh is ``load_resume_state`` + ``place_tree`` with the
+new mesh's shardings — the exact path the Trainer already runs, on whatever
+mesh ``make_mesh`` built from the devices the relaunched process has.
+
+What stays consistent across a topology change, and why:
+
+- **step/epoch/best-acc** — scalars in the payload, topology-free;
+- **optimizer state** — host pytrees re-placed like params;
+- **PRNG** — all device-side randomness derives from
+  ``fold_in(root_key, epoch/step)`` (utils/seed.py); keys are *functions of
+  the trajectory*, never of a device index, so no per-device key state
+  needs re-folding — a resumed epoch draws the same augmentations on 4
+  devices as it would have on 8;
+- **the loss trajectory** — identical up to float reduction order (batches
+  are split across a different number of devices, so cross-device sums
+  reassociate; ``tests/test_resilience.py`` pins allclose, not bitwise).
+
+What legitimately changes: the global batch must still divide the new data
+axis (the Trainer validates and raises with the actual numbers), and
+host-streaming loaders re-shard by the new process count.
+
+This module provides the *observability* half: record the saving topology
+in the checkpoint manifest, and describe the delta at restore time.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def forced_host_device_env(n: int, base: dict | None = None) -> dict:
+    """Subprocess environment forcing ``n`` virtual CPU devices — the one
+    recipe behind every elastic-on-CPU child (tests, ``bench.py
+    --resilience``): replace any existing
+    ``--xla_force_host_platform_device_count`` in ``XLA_FLAGS``, pin the
+    CPU backend, and keep the axon TPU plugin out.  Returns a COPY of
+    ``base`` (default ``os.environ``) — never mutates the caller's env,
+    so nothing leaks between children or into this process."""
+    env = dict(os.environ if base is None else base)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + [f"--xla_force_host_platform_device_count={n}"]
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the TPU plugin out of children
+    return env
+
+
+def topology() -> dict:
+    """The current process's device topology, for manifests and goodput
+    records."""
+    return {
+        "devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "processes": jax.process_count(),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def mesh_meta(mesh) -> dict:
+    """Manifest fragment recording the mesh a checkpoint was saved under."""
+    return {"mesh": dict(mesh.shape), **topology()}
+
+
+def describe_restore(manifest: dict | None, mesh) -> str | None:
+    """A human-readable elastic-restore notice, or None when the topology is
+    unchanged (or the checkpoint predates manifests)."""
+    if not manifest:
+        return None
+    saved_mesh = manifest.get("mesh")
+    saved_devices = manifest.get("devices")
+    now = dict(mesh.shape)
+    now_devices = jax.device_count()
+    if saved_mesh == now and saved_devices in (None, now_devices):
+        return None
+    return (
+        "elastic restore: checkpoint saved under mesh "
+        f"{saved_mesh} ({saved_devices} devices, "
+        f"{manifest.get('processes', '?')} processes) → restoring onto mesh "
+        f"{now} ({now_devices} devices, {jax.process_count()} processes); "
+        "host-pytree state re-sharded, PRNG trajectory unchanged"
+    )

@@ -1,0 +1,158 @@
+"""Deterministic, seeded fault-injection harness.
+
+A production run meets preemptions, torn checkpoint writes, and slow-downs;
+CI never does unless they are injected on purpose.  A ``FaultPlan`` parses a
+``--fault-plan`` spec and fires the configured faults at configured points
+of the epoch loop, deterministically — the same (spec, seed, trajectory)
+always produces the same failures, so a recovery bug reproduces.
+
+Spec syntax (``;``- or ``,``-separated events)::
+
+    preempt@epoch=2            # injected preemption at the END of epoch 2
+    ckpt_fail@epoch=1          # epoch 1's last.ckpt write raises OSError
+    torn_write@epoch=1         # epoch 1's last.ckpt is torn AFTER landing
+    stall@epoch=0:secs=0.5     # 0.5 s step-time stall after epoch 0
+    preempt@prob=0.1           # seeded per-epoch Bernoulli alternative
+
+``epoch=K`` events whose effect lands AFTER epoch K's checkpoint
+(``preempt``, ``torn_write``, ``stall``) are one-shot across restarts *by
+construction*: the supervisor relaunches with ``--auto-resume``, training
+resumes past epoch K, the trigger condition is never true again, and the
+run completes — no need to strip the fault plan from the restart command.
+``ckpt_fail@epoch=K`` is the deliberate exception: it blocks epoch K's
+save, so a restart resumes at-or-before K and the fault re-fires — the
+persistent-write-failure scenario (a genuinely dying disk), which the
+supervisor's restart budget must bound rather than outrun.  ``prob=p``
+events draw from a counter-free RNG keyed on ``(seed, kind, epoch)`` so a
+restart replays identical decisions for identical epochs.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from pathlib import Path
+
+KINDS = ("preempt", "ckpt_fail", "torn_write", "stall")
+
+
+class FaultSpecError(ValueError):
+    """Malformed ``--fault-plan`` spec."""
+
+
+@dataclass
+class FaultEvent:
+    kind: str
+    epoch: int | None = None   # fire at the end of exactly this epoch
+    prob: float | None = None  # or: per-epoch Bernoulli at this rate
+    secs: float = 0.0          # stall duration
+
+    def due(self, epoch: int, seed: int) -> bool:
+        if self.epoch is not None:
+            return epoch == self.epoch
+        if self.prob is not None:
+            # keyed, counter-free draw: deterministic per (seed, kind, epoch)
+            # regardless of how many other events fired before — restarts
+            # replay the same decisions for the same epochs
+            return random.Random(f"{seed}:{self.kind}:{epoch}").random() < self.prob
+        return False
+
+
+@dataclass
+class FaultPlan:
+    """A parsed fault plan; the Trainer polls it at epoch boundaries."""
+
+    events: list[FaultEvent] = field(default_factory=list)
+    seed: int = 0
+
+    @classmethod
+    def parse(cls, spec: str | None, seed: int = 0) -> "FaultPlan | None":
+        """Parse a ``--fault-plan`` spec; None/empty spec → no plan."""
+        if not spec or not spec.strip():
+            return None
+        events = []
+        for item in spec.replace(",", ";").split(";"):
+            item = item.strip()
+            if not item:
+                continue
+            kind, _, argstr = item.partition("@")
+            kind = kind.strip()
+            if kind not in KINDS:
+                raise FaultSpecError(
+                    f"unknown fault kind {kind!r} in {item!r} (known: {KINDS})"
+                )
+            kwargs: dict = {}
+            for pair in argstr.split(":"):
+                if not pair.strip():
+                    continue
+                key, _, val = pair.partition("=")
+                key, val = key.strip(), val.strip()
+                try:
+                    if key == "epoch":
+                        kwargs["epoch"] = int(val)
+                    elif key == "prob":
+                        kwargs["prob"] = float(val)
+                    elif key == "secs":
+                        kwargs["secs"] = float(val)
+                    else:
+                        raise FaultSpecError(
+                            f"unknown fault arg {key!r} in {item!r} "
+                            "(known: epoch, prob, secs)"
+                        )
+                except ValueError as e:
+                    if isinstance(e, FaultSpecError):
+                        raise
+                    raise FaultSpecError(
+                        f"bad value {val!r} for {key!r} in {item!r}"
+                    ) from None
+            if kwargs.get("epoch") is None and kwargs.get("prob") is None:
+                raise FaultSpecError(
+                    f"fault {item!r} needs an epoch=K or prob=P trigger"
+                )
+            events.append(FaultEvent(kind=kind, **kwargs))
+        return cls(events=events, seed=seed)
+
+    def _due(self, kind: str, epoch: int) -> list[FaultEvent]:
+        return [e for e in self.events if e.kind == kind and e.due(epoch, self.seed)]
+
+    def preempt_due(self, epoch: int) -> bool:
+        """Injected preemption fires at the end of ``epoch``."""
+        return bool(self._due("preempt", epoch))
+
+    def stall_secs(self, epoch: int) -> float:
+        """Total injected step-time stall after ``epoch`` (0.0 = none)."""
+        return sum(e.secs for e in self._due("stall", epoch))
+
+    def ckpt_hook(self, epoch: int):
+        """A write-fault hook for this epoch's resumable save, or None.
+
+        The hook is called by ``save_resume_state`` as ``hook(stage, path)``:
+        ``"pre"`` before any bytes land (``ckpt_fail`` raises here — the
+        write never happens, and the failure must surface through the async
+        writer's ``wait()``), ``"post"`` after payload+manifest are durable
+        (``torn_write`` corrupts the payload here, bypassing the atomic
+        machinery the way a dying disk would — the manifest then no longer
+        matches, which is exactly what verify-on-restore must catch).
+        """
+        fail = bool(self._due("ckpt_fail", epoch))
+        tear = bool(self._due("torn_write", epoch))
+        if not (fail or tear):
+            return None
+
+        def hook(stage: str, path: Path) -> None:
+            if stage == "pre" and fail:
+                raise OSError(
+                    f"injected checkpoint write failure (fault plan, epoch {epoch})"
+                )
+            if stage == "post" and tear:
+                tear_file(path)
+
+        return hook
+
+
+def tear_file(path: str | Path) -> None:
+    """Simulate a torn write: truncate the file to half its bytes, in place,
+    without touching its manifest (a real torn write updates neither)."""
+    path = Path(path)
+    data = path.read_bytes()
+    path.write_bytes(data[: max(1, len(data) // 2)])

@@ -1,0 +1,213 @@
+"""Restart supervisor: run the training command until it exits cleanly.
+
+The shell loop in ``src/tpu_jax/run_elastic.sh`` was the seed of this idea;
+the supervisor makes it a programmable primitive: per-attempt command and
+environment builders (the elastic tests relaunch with a *different* forced
+device count), preemption-aware budgeting (``EXIT_PREEMPTED`` relaunches
+immediately — the machine was taken away, the code is fine; any other
+nonzero exit consumes the restart budget and backs off exponentially), and
+a machine-readable attempt log that feeds goodput accounting.
+
+Recovery composes three existing primitives: every epoch writes a verified
+resumable ``last.ckpt`` (``ckpt_io``), ``--auto-resume`` continues the
+newest run from its newest *valid* checkpoint (falling back to the rotated
+previous one if the newest is torn), and the mesh is rebuilt from whatever
+devices the relaunched process actually has (``elastic``).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Callable, Sequence
+
+from .preempt import EXIT_PREEMPTED
+
+
+def _default_runner(cmd: Sequence[str], env: dict | None) -> int:
+    return subprocess.run(list(cmd), env=env).returncode
+
+
+class Supervisor:
+    """Relaunch a command until success, a budget, or an unretryable exit.
+
+    ``cmd``/``env`` may be static or callables of the attempt index — the
+    hook the elastic tests use to change the forced device count between
+    attempts, and a real deployment would use to re-render the launch
+    command for a resized slice.
+    """
+
+    def __init__(
+        self,
+        cmd: Sequence[str] | Callable[[int], Sequence[str]],
+        *,
+        env: dict | Callable[[int], dict] | None = None,
+        max_restarts: int = 3,
+        backoff_base: float = 1.0,
+        backoff_max: float = 60.0,
+        preempt_exit_code: int = EXIT_PREEMPTED,
+        runner: Callable[[Sequence[str], dict | None], int] | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+        log: Callable[[str], None] | None = None,
+    ) -> None:
+        self._cmd = cmd
+        self._env = env
+        self.max_restarts = max_restarts
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.preempt_exit_code = preempt_exit_code
+        self._runner = runner or _default_runner
+        self._sleep = sleep
+        self._log = log or (lambda msg: print(f"[supervisor] {msg}", file=sys.stderr))
+
+    def _resolve(self, attempt: int) -> tuple[list[str], dict | None]:
+        cmd = self._cmd(attempt) if callable(self._cmd) else self._cmd
+        env = self._env(attempt) if callable(self._env) else self._env
+        return list(cmd), env
+
+    def run(self) -> dict:
+        """The restart loop.  Returns a summary dict::
+
+            {"final_rc": int, "restarts": int, "preemptions": int,
+             "downtime_s": float,   # backoff sleep between attempts
+             "attempts": [{"attempt", "returncode", "seconds", "preempted"}]}
+        """
+        attempts: list[dict] = []
+        crashes = 0
+        preemptions = 0
+        downtime = 0.0
+        attempt = 0
+        while True:
+            cmd, env = self._resolve(attempt)
+            t0 = time.monotonic()
+            rc = self._runner(cmd, env)
+            seconds = time.monotonic() - t0
+            preempted = rc == self.preempt_exit_code
+            attempts.append(
+                {
+                    "attempt": attempt,
+                    "returncode": rc,
+                    "seconds": round(seconds, 3),
+                    "preempted": preempted,
+                }
+            )
+            if rc == 0:
+                break
+            if preempted:
+                # counted before the budget check so a final preempted
+                # attempt that exhausts the budget still shows up
+                preemptions += 1
+            restarts_used = len(attempts) - 1
+            if restarts_used >= self.max_restarts:
+                self._log(
+                    f"giving up after {restarts_used} restarts (last rc={rc})"
+                )
+                break
+            if preempted:
+                # the machine went away, not the code: relaunch immediately
+                self._log(
+                    f"attempt {attempt} preempted (rc={rc}); relaunching "
+                    f"with --auto-resume ({restarts_used + 1}/{self.max_restarts})"
+                )
+            else:
+                crashes += 1
+                backoff = min(
+                    self.backoff_max, self.backoff_base * 2 ** (crashes - 1)
+                )
+                self._log(
+                    f"attempt {attempt} failed (rc={rc}); backing off "
+                    f"{backoff:.1f}s then restarting "
+                    f"({restarts_used + 1}/{self.max_restarts})"
+                )
+                self._sleep(backoff)
+                downtime += backoff
+            attempt += 1
+        return {
+            "final_rc": attempts[-1]["returncode"],
+            "restarts": len(attempts) - 1,
+            "preemptions": preemptions,
+            "downtime_s": round(downtime, 3),
+            "attempts": attempts,
+        }
+
+
+def strip_resume_flag(args: Sequence[str]) -> list[str]:
+    """Drop an explicit ``--resume PATH`` (either flag form) from an argv."""
+    out, skip = [], False
+    for a in args:
+        if skip:
+            skip = False
+            continue
+        if a == "--resume":
+            skip = True
+            continue
+        if a.startswith("--resume="):
+            continue
+        out.append(a)
+    return out
+
+
+def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
+    """``--supervise`` mode of the shared entry point: relaunch this same
+    command (minus ``--supervise``, plus ``--auto-resume --resilience``) as
+    a child process under the restart policy, then aggregate the attempts'
+    goodput records into ``GOODPUT.json``.
+
+    CLI-only by construction: the child command is rebuilt from
+    ``sys.argv[0]`` (the backend's ``main.py``), the one invocation shape in
+    which "run myself again" is well-defined.
+    """
+    from .goodput import aggregate_goodput, collect_goodput_records, write_goodput
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    child_args = [a for a in argv if a != "--supervise"]
+    for extra in ("--auto-resume", "--resilience"):
+        if extra not in child_args:
+            child_args.append(extra)
+
+    def cmd_for(attempt: int) -> list[str]:
+        # An explicit --resume belongs to attempt 0: it resumes the
+        # ORIGINAL checkpoint into a fresh version dir.  Once an attempt
+        # has saved progress, restarts must continue from it (--auto-resume
+        # discovery of the newest valid last.ckpt) — re-resuming the
+        # original file would discard every prior attempt's epochs and
+        # re-fire epoch=K fault events forever.  But if NO attempt has
+        # saved anything yet (crash before the first last.ckpt), stripping
+        # --resume would silently retrain from scratch — keep retrying the
+        # original checkpoint until real progress exists.
+        args = child_args
+        if attempt > 0:
+            from ..train.checkpoint import find_valid_resume  # lazy: avoid cycle
+
+            if find_valid_resume(hparams.ckpt_path) is not None:
+                args = strip_resume_flag(child_args)
+        return [sys.executable, sys.argv[0]] + args
+
+    sup = Supervisor(
+        cmd_for,
+        max_restarts=getattr(hparams, "max_restarts", 3),
+        backoff_base=getattr(hparams, "restart_backoff", 1.0),
+    )
+    t_start = time.time()
+    summary = sup.run()
+
+    # aggregate the per-attempt goodput records the children appended —
+    # across ALL version dirs (an attempt that died pre-first-save leaves
+    # its record in one dir while the relaunch progresses in the next),
+    # filtered to this run's attempts by record timestamp
+    records = collect_goodput_records(hparams.ckpt_path, since=t_start)
+    report = aggregate_goodput(
+        records,
+        downtime_s=summary["downtime_s"],
+        restarts=summary["restarts"],
+        preemptions=summary["preemptions"],
+    )
+    out_path = getattr(hparams, "goodput_json", None) or "GOODPUT.json"
+    write_goodput(out_path, report)
+    return {
+        "supervisor": summary,
+        "goodput": report,
+        "goodput_json": str(out_path),
+        "exit_code": summary["final_rc"],
+    }

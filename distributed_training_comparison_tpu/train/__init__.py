@@ -19,6 +19,8 @@ from .state import TrainState, create_train_state
 from .step import make_train_step, make_eval_step, make_eval_runner, make_epoch_runner, make_chunk_runner
 from .async_ckpt import AsyncCheckpointer
 from .checkpoint import (
+    agreed_version_dir,
+    find_valid_resume,
     find_version_dir,
     find_serving_checkpoint,
     save_checkpoint,
@@ -40,6 +42,8 @@ __all__ = [
     "make_eval_runner",
     "make_epoch_runner",
     "AsyncCheckpointer",
+    "agreed_version_dir",
+    "find_valid_resume",
     "find_version_dir",
     "find_serving_checkpoint",
     "save_checkpoint",

@@ -60,7 +60,8 @@ class AsyncCheckpointer:
                 if job is not None:  # None => superseded, already written
                     job()
             except BaseException as e:  # surfaced on wait()/close()
-                self._errors.append(e)
+                with self._lock:
+                    self._errors.append(e)
             finally:
                 self._q.task_done()
 
@@ -71,19 +72,27 @@ class AsyncCheckpointer:
             self._latest[key] = job
         self._q.put(key)
 
+    def _raise_collected(self) -> None:
+        """Surface worker failures: a background save that failed must never
+        be silently swallowed — the run would end believing its checkpoints
+        exist.  Raises the FIRST collected error (chained), noting how many
+        followed; clears the list so a handled failure isn't re-raised by a
+        later drain."""
+        with self._lock:
+            err, self._errors = self._errors[:], []
+        if err:
+            extra = f" (+{len(err) - 1} more)" if len(err) > 1 else ""
+            raise RuntimeError(
+                f"async checkpoint write failed: {err[0]!r}{extra}"
+            ) from err[0]
+
     def wait(self) -> None:
         """Block until every queued job has finished; re-raise any failure."""
         self._q.join()
-        if self._errors:
-            err = self._errors[:]
-            self._errors.clear()
-            raise RuntimeError(f"async checkpoint write failed: {err[0]!r}") from err[0]
+        self._raise_collected()
 
     def close(self) -> None:
         if self._thread.is_alive():
             self._q.put(None)
             self._thread.join()
-        if self._errors:
-            err = self._errors[:]
-            self._errors.clear()
-            raise RuntimeError(f"async checkpoint write failed: {err[0]!r}") from err[0]
+        self._raise_collected()

@@ -17,14 +17,25 @@ pickle is the reference's load path, ``src/single/main.py:25``).
 
 from __future__ import annotations
 
+import logging
 import re
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
+import numpy as np
 from flax import serialization
 
 from ..parallel.sharding import fetch_to_host
+from ..resilience.ckpt_io import (
+    atomic_write_bytes,
+    previous_path,
+    rotate_previous,
+    verify_checkpoint,
+    write_manifest,
+)
 from .state import TrainState
+
+_log = logging.getLogger("dtc_tpu")
 
 BEST_PREFIX = "best_model_"
 LAST_NAME = "last.ckpt"
@@ -52,15 +63,53 @@ def _check_ckpt_fmt(raw: dict, params, path) -> None:
 
 def find_version_dir(ckpt_root: str | Path, create: bool = True) -> Path:
     """First nonexistent ``version-{n}`` under ``ckpt_root`` (reference
-    ``src/single/trainer.py:52-59``)."""
+    ``src/single/trainer.py:52-59``).
+
+    Claiming is race-safe: the scan-then-``mkdir(exist_ok=True)`` original
+    had a TOCTOU hole — two processes scanning concurrently could both see
+    ``version-3`` free and silently share it, interleaving their
+    checkpoints.  Here the claim IS the ``mkdir(exist_ok=False)``: the
+    filesystem arbitrates, the loser re-scans from the next index.
+    """
     root = Path(ckpt_root)
     n = 0
-    while (root / f"version-{n}").exists():
-        n += 1
-    d = root / f"version-{n}"
-    if create:
-        d.mkdir(parents=True, exist_ok=True)
-    return d
+    while True:
+        d = root / f"version-{n}"
+        if d.exists():
+            n += 1
+            continue
+        if not create:
+            return d
+        try:
+            d.mkdir(parents=True, exist_ok=False)
+            return d
+        except FileExistsError:  # lost the claim race; try the next slot
+            n += 1
+
+
+def agreed_version_dir(ckpt_root: str | Path) -> Path:
+    """Multi-host version-dir choice: process 0 claims (race-safely), every
+    other process follows its broadcast pick.
+
+    Under ``jax.distributed`` each host scanning independently could claim
+    different slots (local-FS ``ckpt_root``) or race each other (shared FS).
+    This is a COLLECTIVE — every process must call it, in the same order
+    relative to other collectives.  Non-zero processes do not ``mkdir``:
+    on a shared FS the dir already exists, on local FS only process 0
+    writes checkpoints anyway.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return find_version_dir(ckpt_root)
+    from jax.experimental import multihost_utils
+
+    if jax.process_index() == 0:
+        chosen = int(find_version_dir(ckpt_root).name.split("-")[-1])
+    else:
+        chosen = 0  # placeholder; broadcast overwrites with rank 0's claim
+    chosen = int(multihost_utils.broadcast_one_to_all(np.asarray(chosen)))
+    return Path(ckpt_root) / f"version-{chosen}"
 
 
 def _state_dict(state: TrainState) -> dict[str, Any]:
@@ -94,9 +143,7 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
         "val_acc": float(val_acc),
     }
     path = version_dir / f"{BEST_PREFIX}epoch_{epoch}_acc_{val_acc:.4f}.ckpt"
-    tmp = path.with_suffix(".tmp")  # atomic-ish, like save_resume_state
-    tmp.write_bytes(serialization.msgpack_serialize(payload))
-    tmp.replace(path)
+    atomic_write_bytes(path, serialization.msgpack_serialize(payload))
     # drop superseded best files only AFTER the new one is durably in place
     # — a crash mid-save (fetch can take seconds) must never leave the
     # version dir with zero best checkpoints
@@ -141,6 +188,43 @@ def find_latest_resume(ckpt_root: str | Path) -> Path | None:
         return None
     path = dirs[0] / LAST_NAME
     return path if path.exists() else None
+
+
+def find_valid_resume_bytes(ckpt_root: str | Path) -> tuple[Path, bytes] | None:
+    """Verify-on-restore discovery: the newest version dir's ``last.ckpt``
+    only if its integrity manifest checks out, else the rotated previous
+    good checkpoint (``prev-last.ckpt``), else None — returned WITH the
+    verified payload bytes, so restore reuses the buffer instead of paying
+    a second full read of a possibly multi-GB state.
+
+    This is the discovery rule --auto-resume uses once resilience is in
+    play: a torn ``last.ckpt`` (crash mid-write on a non-atomic filesystem,
+    a dying disk, an injected ``torn_write`` fault) must cost one epoch of
+    progress, never the run."""
+    dirs = _version_dirs_newest_first(ckpt_root)
+    if not dirs:
+        return None
+    newest = dirs[0] / LAST_NAME
+    for candidate in (newest, previous_path(newest)):
+        if not candidate.exists():
+            continue
+        data = candidate.read_bytes()
+        ok, reason = verify_checkpoint(candidate, data=data)
+        if ok:
+            if candidate != newest:
+                _log.warning(
+                    f"auto-resume: {newest.name} failed verification; "
+                    f"falling back to previous good checkpoint {candidate.name}"
+                )
+            return candidate, data
+        _log.warning(f"auto-resume: rejecting {candidate}: {reason}")
+    return None
+
+
+def find_valid_resume(ckpt_root: str | Path) -> Path | None:
+    """Path-only form of ``find_valid_resume_bytes``."""
+    hit = find_valid_resume_bytes(ckpt_root)
+    return hit[0] if hit else None
 
 
 def _best_sort_key(path: Path) -> tuple[int, float]:
@@ -232,25 +316,71 @@ def find_serving_checkpoint(ckpt_root: str | Path) -> Path | None:
 
 
 def save_resume_state(
-    version_dir: str | Path, state: TrainState, epoch: int, best_acc: float
+    version_dir: str | Path,
+    state: TrainState,
+    epoch: int,
+    best_acc: float,
+    fault_hook: Callable[[str, Path], None] | None = None,
+    meta: dict | None = None,
 ) -> Path:
-    """Write the fully-resumable ``last.ckpt`` (capability the reference lacks)."""
+    """Write the fully-resumable ``last.ckpt`` (capability the reference
+    lacks), crash-safely:
+
+    1. the existing (size-valid) ``last.ckpt`` rotates to ``prev-last.ckpt``
+       — the fallback verify-on-restore reaches for;
+    2. the payload lands via tmp+fsync+rename (never a torn visible file
+       from a crash of THIS process);
+    3. a sidecar manifest (payload SHA-256 + step/epoch/mesh metadata) is
+       written after the payload, so external corruption — or a crash
+       between the two writes — fails verification instead of poisoning the
+       next restart.
+
+    ``fault_hook(stage, path)`` is the fault-injection seam
+    (``FaultPlan.ckpt_hook``): ``"pre"`` may raise (write failure),
+    ``"post"`` may corrupt the landed file (torn write).  ``meta`` merges
+    into the manifest (the Trainer records the saving mesh topology for
+    elastic-restore accounting)."""
+    host_state = serialization.to_state_dict(fetch_to_host(_state_dict(state)))
     payload = {
         "fmt": CKPT_FMT,
-        "state": serialization.to_state_dict(fetch_to_host(_state_dict(state))),
+        "state": host_state,
         "epoch": epoch,
         "best_acc": float(best_acc),
     }
     path = Path(version_dir) / LAST_NAME
-    tmp = path.with_suffix(".tmp")  # atomic-ish: never leave a torn last.ckpt
-    tmp.write_bytes(serialization.msgpack_serialize(payload))
-    tmp.replace(path)
+    if fault_hook is not None:
+        fault_hook("pre", path)
+    data = serialization.msgpack_serialize(payload)
+    rotate_previous(path)
+    atomic_write_bytes(path, data)
+    write_manifest(
+        path,
+        data,
+        meta={
+            "kind": "resume_state",
+            "fmt": CKPT_FMT,
+            "step": int(np.asarray(host_state["step"])),
+            "epoch": int(epoch),
+            "best_acc": float(best_acc),
+            **(meta or {}),
+        },
+    )
+    if fault_hook is not None:
+        fault_hook("post", path)
     return path
 
 
-def load_resume_state(path: str | Path, state: TrainState) -> tuple[TrainState, int, float]:
-    """Restore ``(state, next_epoch, best_acc)`` from a ``last.ckpt``."""
-    raw = serialization.msgpack_restore(Path(path).read_bytes())
+def load_resume_state(
+    path: str | Path, state: TrainState, raw_bytes: bytes | None = None
+) -> tuple[TrainState, int, float]:
+    """Restore ``(state, next_epoch, best_acc)`` from a ``last.ckpt``.
+
+    ``raw_bytes`` lets a caller that already read the file (to verify its
+    manifest) restore from the same buffer — one disk read of a possibly
+    multi-GB state instead of two."""
+    raw = serialization.msgpack_restore(
+        raw_bytes if raw_bytes is not None else Path(path).read_bytes()
+    )
     _check_ckpt_fmt(raw, state.params, path)
     restored = serialization.from_state_dict(_state_dict(state), raw["state"])
     state = state.replace(

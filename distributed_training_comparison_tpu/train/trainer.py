@@ -48,6 +48,15 @@ from ..parallel.sharding import (
     put_replicated,
     shard_batch,
 )
+from ..resilience import (
+    FaultPlan,
+    GoodputMeter,
+    Preempted,
+    PreemptionHandler,
+    read_manifest,
+    verify_checkpoint,
+)
+from ..resilience import elastic, goodput as goodput_mod
 from ..utils import AverageMeter, fix_seed, setup_logger
 from ..utils.tensorboard import SummaryWriter
 from . import checkpoint as ckpt
@@ -75,7 +84,20 @@ class Trainer:
     """Drives training of a model over a mesh; one instance per run."""
 
     def __init__(self, hparams, model=None, mesh=None):
+        self._t_construct = time.monotonic()
         self.hparams = hparams
+        # --- resilience: fault plan + preemption latch + goodput meter.
+        # The goodput meter always runs (host-side timers, ~free); the
+        # signal handler installs only for resilient runs so tests and
+        # library embedders keep their own SIGTERM semantics.
+        self.goodput = GoodputMeter()
+        self.fault_plan = FaultPlan.parse(
+            getattr(hparams, "fault_plan", None),
+            seed=getattr(hparams, "fault_seed", 0),
+        )
+        self.preempt_handler = None
+        if getattr(hparams, "resilience", False) or self.fault_plan is not None:
+            self.preempt_handler = PreemptionHandler().install()
         self.mesh = mesh if mesh is not None else make_mesh(
             hparams.num_devices, hparams.model_parallel, backend=hparams.backend
         )
@@ -349,13 +371,41 @@ class Trainer:
         # reference lacks entirely (torchelastic is quoted in its README but
         # never implemented, SURVEY.md §5).  Explicit --resume wins.
         auto_resumed = False
+        resume_bytes = None  # one read serves verify + restore (states can be GBs)
         if getattr(hparams, "auto_resume", False) and not getattr(
             hparams, "resume", None
         ):
-            latest = ckpt.find_latest_resume(hparams.ckpt_path)
-            if latest is not None:
-                hparams.resume = str(latest)
+            # verify-on-restore: a torn newest checkpoint falls back to the
+            # rotated previous good one instead of crashing the relaunch
+            hit = ckpt.find_valid_resume_bytes(hparams.ckpt_path)
+            if hit is not None:
+                hparams.resume = str(hit[0])
+                resume_bytes = hit[1]
                 auto_resumed = True
+        if jax.process_count() > 1:
+            # The branch below is collective-bearing, so every process must
+            # take the SAME one.  --ckpt-path is contractually a shared
+            # filesystem under multi-host (every process scans the same
+            # checkpoint dirs); broadcast process 0's discovery and fail
+            # loudly on disagreement — a local-FS misconfiguration must not
+            # become a silent collective mismatch/deadlock.
+            from jax.experimental import multihost_utils
+
+            agreed = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(auto_resumed))
+            )
+            if agreed != auto_resumed:
+                raise RuntimeError(
+                    "--auto-resume discovery disagrees across hosts "
+                    f"(process 0: {agreed}, this process: {auto_resumed}); "
+                    "--ckpt-path must be a filesystem shared by every host"
+                )
+        # Fresh version dirs are claimed race-safely (mkdir is the claim);
+        # under multi-host, process 0 claims and the rest follow its
+        # broadcast pick — a COLLECTIVE, so it runs on every process.
+        agreed_dir = None
+        if not auto_resumed and jax.process_count() > 1:
+            agreed_dir = ckpt.agreed_version_dir(hparams.ckpt_path)
         if self.is_main:
             # Only an auto-DISCOVERED checkpoint continues in its own
             # version dir; an explicit --resume (even with --auto-resume
@@ -364,7 +414,7 @@ class Trainer:
             self.version_dir = (
                 Path(hparams.resume).parent
                 if auto_resumed
-                else ckpt.find_version_dir(hparams.ckpt_path)
+                else (agreed_dir or ckpt.find_version_dir(hparams.ckpt_path))
             )
             self.writer = SummaryWriter(self.version_dir / "tb")
             self._dump_hparams()
@@ -376,17 +426,40 @@ class Trainer:
         )
 
         if getattr(hparams, "resume", None):
+            if resume_bytes is None:
+                # explicit --resume: read once, verify that buffer (a torn
+                # file fails loudly at the CLI, not mid-restore), restore
+                # from it.  Auto-discovered paths arrive with their already-
+                # verified bytes from find_valid_resume_bytes.
+                resume_bytes = Path(hparams.resume).read_bytes()
+                ok, reason = verify_checkpoint(hparams.resume, data=resume_bytes)
+                if not ok:
+                    raise ValueError(
+                        f"refusing to resume from {hparams.resume}: {reason}"
+                    )
             state, self.start_epoch, self.best_acc = ckpt.load_resume_state(
-                hparams.resume, self.state
+                hparams.resume, self.state, raw_bytes=resume_bytes
             )
+            resume_bytes = None  # drop the (possibly GB-sized) buffer now
             # from_state_dict returns host numpy leaves; re-place them as
             # global mesh arrays with the run's layout (jit on a multi-host
-            # mesh requires global jax.Arrays, not host buffers)
+            # mesh requires global jax.Arrays, not host buffers).  The
+            # layout is THIS run's mesh, whatever its device count — the
+            # host-pytree checkpoint format is what makes restoring onto a
+            # resized slice a plain re-placement (resilience/elastic.py).
             self.state = place_tree(state, self.state_sharding)
             self.logger.info(
                 f"Resumed from {hparams.resume} at epoch {self.start_epoch} "
                 f"(best acc {self.best_acc:.4f})"
             )
+            elastic_msg = elastic.describe_restore(
+                read_manifest(hparams.resume), self.mesh
+            )
+            if elastic_msg:
+                self.logger.info(elastic_msg)
+        # init/recovery cost: construction through restore + program builds
+        # — the price every restart pays again, charged against goodput
+        self._init_secs = time.monotonic() - self._t_construct
 
     # ------------------------------------------------------------------ utils
 
@@ -431,6 +504,7 @@ class Trainer:
             f"{self.precision}"
         )
         t_start = time.perf_counter()
+        self.goodput.add("init", self._init_secs)
         profile_epoch = (
             self.start_epoch + 1
             if hp.epoch - self.start_epoch > 1
@@ -448,6 +522,7 @@ class Trainer:
             else:
                 losses, top1 = self._train_epoch_host(epoch)
             epoch_time = time.perf_counter() - t0
+            self.goodput.add("step", epoch_time)
             if profiling:
                 jax.profiler.stop_trace()
                 self.logger.info(f"profiler trace written to {hp.profile_dir}")
@@ -498,7 +573,8 @@ class Trainer:
                 if getattr(hp, "log_every_step", False):
                     self._log_tb("loss/step", float(loss), gstep)
 
-            val = self.validate(epoch)
+            with self.goodput.phase("eval"):
+                val = self.validate(epoch)
             lr_now = float(self.lr_schedule(epoch * self.steps_per_epoch))
             self.logger.info(
                 f"[{hp.backend.upper()} Version {self.version} Epoch {epoch}] "
@@ -559,13 +635,14 @@ class Trainer:
                 # state (opt_state included) is gathered only when the
                 # resumable last.ckpt is due — halves the DCN volume on
                 # best-improvement epochs.
-                if want_last:
-                    state_ref = fetch_to_host(state_ref)
-                else:
-                    state_ref = state_ref.replace(
-                        params=fetch_to_host(state_ref.params),
-                        batch_stats=fetch_to_host(state_ref.batch_stats),
-                    )
+                with self.goodput.phase("ckpt"):
+                    if want_last:
+                        state_ref = fetch_to_host(state_ref)
+                    else:
+                        state_ref = state_ref.replace(
+                            params=fetch_to_host(state_ref.params),
+                            batch_stats=fetch_to_host(state_ref.batch_stats),
+                        )
             if self.is_main:
                 # write-behind: the worker thread fetches + serializes while
                 # the next epoch computes (state buffers are not donated)
@@ -578,19 +655,138 @@ class Trainer:
                     )
                 if want_last:
                     self._last_resume_save = time.monotonic()
+                    hook = (
+                        self.fault_plan.ckpt_hook(epoch)
+                        if self.fault_plan is not None
+                        else None
+                    )
                     self.ckpt_writer.submit(
-                        lambda s=state_ref, e=epoch, b=self.best_acc: (
-                            ckpt.save_resume_state(vdir, s, e, b)
+                        lambda s=state_ref, e=epoch, b=self.best_acc, h=hook: (
+                            ckpt.save_resume_state(
+                                vdir, s, e, b,
+                                fault_hook=h,
+                                meta=elastic.mesh_meta(self.mesh),
+                            )
                         ),
                         key="last",
                     )
+            self._log_tb(
+                "goodput/productive_frac", self.goodput.productive_frac(), epoch
+            )
+            # --- resilience hooks, at the epoch boundary (the epoch itself
+            # is one device program — the smallest interruptible unit)
+            if self.fault_plan is not None:
+                stall = self.fault_plan.stall_secs(epoch)
+                if stall > 0:
+                    self.logger.warning(
+                        f"injected stall: {stall:.2f}s after epoch {epoch}"
+                    )
+                    time.sleep(stall)
+                    self.goodput.add("stall", stall)
+            if self._preempt_due(epoch):
+                self._preempt_exit(epoch, state_ref, want_last, sync_fetch)
         if self.ckpt_writer is not None:
-            self.ckpt_writer.wait()
+            with self.goodput.phase("ckpt"):
+                self.ckpt_writer.wait()
         self.logger.info(
             f"[{hp.backend.upper()} Version {self.version}] done in "
             f"{time.perf_counter() - t_start:.1f}s, best val acc {self.best_acc:.2f}%"
         )
+        self._write_goodput()
         return self.version
+
+    # ------------------------------------------------------------- resilience
+
+    def _preempt_due(self, epoch: int) -> bool:
+        """Preemption pending at the end of ``epoch``?
+
+        SIGTERM delivery is per-host and need not be simultaneous (a
+        partial spot reclaim can evict one VM of the slice), but the drain
+        path runs collectives (symmetric fetch of partitioned state) — so
+        under multi-host the per-host flags are OR-reduced and every
+        process acts on ANY host's preemption together.  The collective
+        only runs for resilient runs (handler or fault plan present):
+        non-resilient multi-host training keeps its schedule unchanged.
+        """
+        if self.preempt_handler is None and self.fault_plan is None:
+            return False
+        due = bool(
+            (self.preempt_handler is not None and self.preempt_handler.triggered)
+            or (self.fault_plan is not None and self.fault_plan.preempt_due(epoch))
+        )
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            due = bool(
+                np.any(multihost_utils.process_allgather(np.asarray(due)))
+            )
+        return due
+
+    def _preempt_exit(self, epoch: int, state_ref, already_saved: bool, sync_fetch: bool):
+        """Drain and exit distinctly: force a final ``last.ckpt`` if this
+        epoch's wasn't already queued (e.g. suppressed by the save
+        throttle), wait out the async writer, record goodput, and raise
+        ``Preempted`` for the entry point to map to ``EXIT_PREEMPTED``."""
+        from ..resilience.preempt import EXIT_PREEMPTED
+
+        self.logger.warning(
+            f"preemption at end of epoch {epoch}: draining checkpoints, "
+            f"then exiting with code {EXIT_PREEMPTED} for the supervisor"
+        )
+        if getattr(self.hparams, "save_last", True) and not already_saved:
+            if sync_fetch:  # throttled epochs skipped the symmetric fetch
+                with self.goodput.phase("ckpt"):
+                    state_ref = fetch_to_host(state_ref)
+            if self.is_main:
+                self.ckpt_writer.submit(
+                    lambda s=state_ref, e=epoch, b=self.best_acc: (
+                        ckpt.save_resume_state(
+                            self.version_dir, s, e, b,
+                            meta=elastic.mesh_meta(self.mesh),
+                        )
+                    ),
+                    key="last",
+                )
+        if self.ckpt_writer is not None:
+            with self.goodput.phase("ckpt"):
+                self.ckpt_writer.wait()
+        self._write_goodput(preempted=True)
+        raise Preempted(
+            epoch=epoch, step=(epoch + 1) * self.steps_per_epoch
+        )
+
+    def _write_goodput(self, preempted: bool = False) -> None:
+        """Append this attempt's goodput record to the run dir's
+        ``goodput.jsonl`` (the supervisor aggregates records across restarts
+        into GOODPUT.json); also honor a direct --goodput-json for
+        unsupervised runs."""
+        if self.goodput.written or not self.is_main or self.version_dir is None:
+            return
+        self.goodput.written = True
+        record = self.goodput.summary()
+        record.update(
+            preempted=preempted,
+            version=self.version,
+            topology=elastic.topology(),
+            start_epoch=self.start_epoch,
+            # lets the supervisor aggregate only ITS run's attempts when
+            # the ckpt root also holds older runs' version dirs
+            written_at=time.time(),
+        )
+        try:
+            goodput_mod.append_goodput_record(
+                self.version_dir / "goodput.jsonl", record
+            )
+            out = getattr(self.hparams, "goodput_json", None)
+            if out:
+                records = goodput_mod.load_goodput_records(
+                    self.version_dir / "goodput.jsonl"
+                )
+                goodput_mod.write_goodput(
+                    out, goodput_mod.aggregate_goodput(records)
+                )
+        except OSError as e:  # accounting must never kill training
+            self.logger.error(f"goodput record write failed: {e}")
 
     def _train_epoch_device(self, epoch: int) -> tuple[np.ndarray, float]:
         """Scanned epoch over the HBM-resident split: one dispatch, one fetch."""
@@ -747,6 +943,11 @@ class Trainer:
         }
 
     def close(self) -> None:
+        # crash path: fit() never reached its goodput write — record what
+        # was accumulated so the attempt still shows up in the aggregate
+        self._write_goodput()
+        if self.preempt_handler is not None:
+            self.preempt_handler.restore()
         if self.ckpt_writer is not None:
             self.ckpt_writer.close()
         if self.writer is not None:

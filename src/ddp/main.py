@@ -18,4 +18,4 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 from distributed_training_comparison_tpu.entry import run
 
 if __name__ == "__main__":
-    run("ddp")
+    sys.exit(run("ddp").get("exit_code", 0))

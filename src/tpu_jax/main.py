@@ -14,4 +14,6 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
 from distributed_training_comparison_tpu.entry import run
 
 if __name__ == "__main__":
-    run("tpu")
+    # exit_code distinguishes preemption (EXIT_PREEMPTED) from crash/success
+    # so the resilience supervisor can pick the right restart policy
+    sys.exit(run("tpu").get("exit_code", 0))

@@ -1,6 +1,12 @@
 #!/bin/sh
 # Elastic supervisor: relaunch training after crashes, resuming in place.
 #
+# NOTE: superseded by run_resilient.sh (the in-process supervisor,
+# `--supervise`), which additionally distinguishes preemption exits from
+# crashes, backs off exponentially, verifies checkpoints on restore, and
+# writes GOODPUT.json.  This shell loop is kept as the
+# no-python-entry-changes fallback.
+#
 # The reference quotes torchelastic as its unimplemented "step 4"
 # (README.md:11,14 — SURVEY.md §5 "failure detection / elastic recovery:
 # none").  Here recovery is two existing primitives composed: every epoch

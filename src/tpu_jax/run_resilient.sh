@@ -1,0 +1,36 @@
+#!/bin/sh
+# Preemption-aware elastic launcher: the north-star config under the
+# resilience supervisor (resilience/supervisor.py via `--supervise`).
+#
+# The supervisor relaunches the training command until it exits cleanly:
+# a preempted child (SIGTERM from the scheduler, or an injected
+# `--fault-plan preempt@...`) drains its async checkpointer, force-writes a
+# verified last.ckpt, and exits with the distinct EXIT_PREEMPTED code — the
+# supervisor relaunches it immediately with --auto-resume, and the child
+# resumes from the newest VALID checkpoint (torn writes fall back to the
+# rotated previous good one) on whatever devices the relaunched process
+# has (elastic restore).  Crashes instead consume the --max-restarts budget
+# with exponential backoff.  Goodput across all attempts lands in
+# GOODPUT.json (pretty-print: python tools/goodput_report.py GOODPUT.json).
+#
+# Fault-injection example (exercise the whole recovery path on real
+# hardware):  sh src/tpu_jax/run_resilient.sh --fault-plan preempt@epoch=10
+EPOCH=50
+BATCH_SIZE=256
+SEED=42
+MAX_RESTARTS="${MAX_RESTARTS:-5}"
+
+python src/tpu_jax/main.py \
+  --supervise \
+  --max-restarts "${MAX_RESTARTS}" \
+  --epoch ${EPOCH} \
+  --batch-size ${BATCH_SIZE} \
+  --seed ${SEED} \
+  --lr 0.1 \
+  --lr-decay-step-size 25 \
+  --lr-decay-gamma 0.1 \
+  --weight-decay 1e-4 \
+  --ckpt-path src/tpu_jax/checkpoints/ \
+  --amp \
+  --contain-test \
+  "$@"

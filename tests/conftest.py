@@ -15,6 +15,7 @@ the module-scope environ write.
 """
 
 import os
+from pathlib import Path
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -49,3 +50,29 @@ def _assert_virtual_mesh():
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture
+def forced_device_env():
+    """Factory for subprocess environments with a FORCED virtual CPU device
+    count (``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+
+    The elastic-restore tests need children running under *different*
+    device counts than this process's 8-device mesh.  The flag must land
+    before jax instantiates its CPU client, so it can only apply to fresh
+    subprocesses — and it is passed via a per-child env COPY, never by
+    mutating ``os.environ``, so nothing leaks into other tests (or into
+    this process, whose backend is already up).
+    """
+    from distributed_training_comparison_tpu.resilience.elastic import (
+        forced_host_device_env,
+    )
+
+    repo = Path(__file__).parent.parent
+
+    def make(n: int) -> dict[str, str]:
+        env = forced_host_device_env(n)
+        env["PYTHONPATH"] = f"{repo}{os.pathsep}" + env.get("PYTHONPATH", "")
+        return env
+
+    return make

@@ -57,6 +57,30 @@ def test_async_error_surfaces_on_wait():
     w.close()
 
 
+def test_async_error_surfaces_on_close_too():
+    """A failed background save must also surface when the only drain point
+    is close() (e.g. a run that never calls wait() again after fit)."""
+    w = AsyncCheckpointer()
+    w.submit(lambda: (_ for _ in ()).throw(OSError("quota exceeded")))
+    with pytest.raises(RuntimeError, match="quota exceeded"):
+        w.close()
+    w.close()  # error list cleared by the raise; close stays idempotent
+
+
+def test_async_multiple_errors_report_count():
+    w = AsyncCheckpointer()
+    gate = threading.Event()
+    w.submit(lambda: gate.wait(5), key="gate")
+    for i in range(2):
+        w.submit(
+            lambda i=i: (_ for _ in ()).throw(OSError(f"boom{i}")), key=f"k{i}"
+        )
+    gate.set()
+    with pytest.raises(RuntimeError, match=r"boom0.*\+1 more"):
+        w.wait()
+    w.close()
+
+
 def test_close_idempotent():
     w = AsyncCheckpointer()
     w.close()

@@ -266,6 +266,64 @@ def test_best_checkpoint_numeric_epoch_sort(tmp_path):
     assert not a.exists() and not stale.exists()  # parseable losers cleaned
 
 
+def _write_ckpt(path, payload):
+    from flax import serialization
+
+    path.write_bytes(serialization.msgpack_serialize(payload))
+
+
+def test_old_fmt_vit_checkpoint_raises_documented_error(tmp_path):
+    """A format-1/2 packed-qkv ViT checkpoint must fail with the documented
+    migration error, not a shape mismatch deep inside from_state_dict."""
+    from distributed_training_comparison_tpu.train import load_eval_variables
+    from distributed_training_comparison_tpu.train.checkpoint import CKPT_FMT
+
+    old_vit = {
+        # fmt key absent → format 1 (pre-versioning packed-qkv era)
+        "params": {"blocks": {"qkv": {"kernel": np.zeros((4, 12), np.float32)}}},
+        "batch_stats": {},
+        "epoch": 3,
+        "val_acc": 50.0,
+    }
+    path = tmp_path / "old_vit.ckpt"
+    _write_ckpt(path, old_vit)
+    vit_template = {
+        "params": {"blocks": {"q_proj": {"kernel": np.zeros((4, 4), np.float32)}}},
+        "batch_stats": {},
+    }
+    with pytest.raises(ValueError, match="format-1 ViT checkpoint"):
+        load_eval_variables(path, vit_template)
+
+    # an explicit format-2 (head-major packed) file names its own format
+    old_vit["fmt"] = 2
+    _write_ckpt(path, old_vit)
+    with pytest.raises(ValueError, match=f"format-2.*current format {CKPT_FMT}"):
+        load_eval_variables(path, vit_template)
+
+
+def test_old_fmt_non_vit_checkpoint_still_loads(tmp_path):
+    """The format gate is ViT-specific: a pre-versioning ResNet-style
+    checkpoint (no packed qkv to migrate) must keep loading."""
+    from distributed_training_comparison_tpu.train import load_eval_variables
+
+    kernel = np.arange(4, dtype=np.float32).reshape(2, 2)
+    payload = {
+        "params": {"dense": {"kernel": kernel}},  # fmt absent → format 1
+        "batch_stats": {},
+        "epoch": 7,
+        "val_acc": 61.0,
+    }
+    path = tmp_path / "old_resnet.ckpt"
+    _write_ckpt(path, payload)
+    template = {
+        "params": {"dense": {"kernel": np.zeros((2, 2), np.float32)}},
+        "batch_stats": {},
+    }
+    restored, info = load_eval_variables(path, template)
+    np.testing.assert_array_equal(restored["params"]["dense"]["kernel"], kernel)
+    assert info == {"epoch": 7, "acc": 61.0}
+
+
 def test_fwd_bwd_hook_rejects_bn_models(mesh, tiny_data):
     """Wiring the 1F1B fwd_bwd hook with a BN model must fail loudly at the
     hook boundary (trace time), not silently freeze running statistics

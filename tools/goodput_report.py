@@ -1,0 +1,85 @@
+"""Pretty-print GOODPUT.json reports and deltas across runs.
+
+Usage::
+
+    python tools/goodput_report.py GOODPUT.json [OTHER.json ...]
+
+One row per report: wall/productive/checkpoint/stall seconds, restart
+count + downtime, and the goodput fraction.  With more than one file, each
+later report also shows its goodput delta vs. the FIRST file (the baseline)
+— the question a resilience change has to answer is "did goodput move",
+and diffing raw JSON by eye does not answer it.
+
+Also accepts a run dir's ``goodput.jsonl`` (per-attempt records): it is
+aggregated on the fly, so an in-flight run can be inspected before its
+supervisor writes the final GOODPUT.json.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def load_report(path: str | Path) -> dict:
+    path = Path(path)
+    if path.suffix == ".jsonl" or path.name == "goodput.jsonl":
+        from distributed_training_comparison_tpu.resilience.goodput import (
+            aggregate_goodput,
+            load_goodput_records,
+        )
+
+        return aggregate_goodput(load_goodput_records(path))
+    return json.loads(path.read_bytes())
+
+
+def _fmt_secs(s: float) -> str:
+    return f"{s:8.1f}s"
+
+
+def format_table(reports: list[tuple[str, dict]]) -> str:
+    header = (
+        f"{'report':<28} {'wall':>9} {'product.':>9} {'ckpt':>9} "
+        f"{'stall':>9} {'restarts':>8} {'downtime':>9} {'goodput':>8} {'Δ':>8}"
+    )
+    lines = [header, "-" * len(header)]
+    base = reports[0][1].get("goodput_frac", 0.0) if reports else 0.0
+    for i, (name, rep) in enumerate(reports):
+        phases = rep.get("phase_totals_s", {})
+        goodput = rep.get("goodput_frac", 0.0)
+        delta = "" if i == 0 else f"{100 * (goodput - base):+7.1f}%"
+        lines.append(
+            f"{name:<28}"
+            f" {_fmt_secs(rep.get('total_wall_s', 0.0))}"
+            f" {_fmt_secs(rep.get('productive_s', 0.0))}"
+            f" {_fmt_secs(phases.get('ckpt', 0.0))}"
+            f" {_fmt_secs(phases.get('stall', 0.0))}"
+            f" {rep.get('restarts', 0):>8}"
+            f" {_fmt_secs(rep.get('restart_downtime_s', 0.0))}"
+            f" {100 * goodput:7.1f}%"
+            f" {delta:>8}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    if not argv or any(a in ("-h", "--help") for a in argv):
+        print(__doc__)
+        return 0 if argv else 2
+    reports = []
+    for arg in argv:
+        label = arg if len(arg) <= 28 else "…" + arg[-27:]
+        try:
+            reports.append((label, load_report(arg)))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {arg}: {e}", file=sys.stderr)
+            return 2
+    print(format_table(reports))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
