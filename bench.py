@@ -804,6 +804,103 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     return record
 
 
+def bench_health(
+    out_path: str = "HEALTH.json",
+    trainer_model=None,
+    extra_argv: tuple = (),
+) -> dict:
+    """The training-health leg: one run through the seeded detector gauntlet
+    — ``nan_grad`` at epoch 1 (non-finite steps skipped by the compiled
+    guard, then rolled back), ``loss_spike`` at epoch 2 (finite spikes
+    caught by the median/MAD window, rolled back) — committed as
+    ``HEALTH.json`` (pretty-print with ``tools/health_report.py``).
+
+    In-process on purpose (unlike the resilience leg's subprocess
+    supervisor): watchdog rollback is an *in-run* recovery, so the leg
+    measures exactly what production pays — the wasted epoch moves from
+    goodput's ``step`` phase to ``rollback``, and the final report carries
+    both the health counters and the goodput split including that waste.
+    ``trainer_model``/``extra_argv`` let the slow-test harness swap in a
+    tiny model and smaller sizing.
+    """
+    import tempfile
+    from pathlib import Path
+
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.health import write_health
+    from distributed_training_comparison_tpu.resilience.goodput import (
+        aggregate_goodput,
+        load_goodput_records,
+    )
+    from distributed_training_comparison_tpu.train import Trainer
+    from distributed_training_comparison_tpu.utils import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
+    platform = jax.devices()[0].platform
+    ckpt_root = tempfile.mkdtemp(prefix="health-bench-")
+    if platform == "cpu":
+        # CI smoke sizing: a single-core resnet18 EPOCH-runner compile alone
+        # costs ~3 min (same constraint bench_resilience sized around), so
+        # the leg runs 3-step epochs and arms the detectors for that scale
+        # (window/baseline 6, rollback at 2 consecutive bad steps, the
+        # spike window covering a whole epoch)
+        size_args = [
+            "--limit-examples", "128", "--batch-size", "32", "--epoch", "4",
+            "--health-window", "6", "--health-bad-steps", "2",
+        ]
+        fault_plan = "nan_grad@epoch=1;loss_spike@epoch=2:step=0:steps=3"
+    else:
+        size_args = ["--limit-examples", "4096", "--batch-size", "256", "--epoch", "6"]
+        fault_plan = "nan_grad@epoch=1;loss_spike@epoch=2"
+    hp = load_config(
+        "tpu",
+        [
+            "--synthetic-data", *size_args,
+            "--ckpt-path", ckpt_root,
+            "--save-last-min-secs", "0", "--no-progress",
+            "--seed", "7",
+            "--fault-plan", fault_plan,
+            *extra_argv,
+        ],
+    )
+    trainer = Trainer(hp, model=trainer_model)
+    try:
+        trainer.fit()
+        summary = trainer.watchdog.summary()
+    finally:
+        trainer.close()
+    records = load_goodput_records(
+        Path(ckpt_root) / "version-0" / "goodput.jsonl"
+    )
+    goodput = aggregate_goodput(records)
+    record = {
+        **summary,
+        "platform": platform,
+        "fault_plan": hp.fault_plan,
+        "goodput": {
+            "goodput_frac": goodput["goodput_frac"],
+            "productive_s": goodput["productive_s"],
+            "rollback_s": goodput["phase_totals_s"]["rollback"],
+            "total_wall_s": goodput["total_wall_s"],
+        },
+    }
+    write_health(out_path, record)
+    print(json.dumps({
+        "metric": record["metric"],
+        "skipped_steps": record["skipped_steps"],
+        "spike_steps": record["spike_steps"],
+        "rollbacks": record["rollbacks"],
+        "desyncs": record["desyncs"],
+        "rollback_s": record["goodput"]["rollback_s"],
+        "goodput_frac": record["goodput"]["goodput_frac"],
+        "platform": platform,
+        "full_record": out_path,
+    }))
+    return record
+
+
 def smoke() -> None:
     """Compile + run one vit_long train step at its design point (4096
     tokens, D=128, batch 8 @ 256px) — the commit-time check that catches a
@@ -857,5 +954,7 @@ if __name__ == "__main__":
         bench_serve()
     elif "--resilience" in sys.argv:
         bench_resilience()
+    elif "--health" in sys.argv:
+        bench_health()
     else:
         main()

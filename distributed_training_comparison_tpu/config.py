@@ -477,6 +477,65 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Also write the aggregated goodput report to this path at the "
         "end of the run (the supervisor always writes GOODPUT.json)",
     )
+    # training health (health/ subsystem: compiled numerics guards + spike
+    # detection + cross-replica desync detection + automatic rollback)
+    parser.add_argument(
+        "--health",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="Training-health watchdog: per-step NaN/Inf guards already "
+        "skip non-finite updates inside the compiled step; the watchdog "
+        "additionally detects loss spikes (rolling median/MAD) and "
+        "cross-replica desync (param fingerprints), and rolls back to the "
+        "last good checkpoint on sustained badness. --no-health restores "
+        "the bare abort-on-divergence behavior (guards stay on)",
+    )
+    parser.add_argument(
+        "--health-window",
+        type=int,
+        default=64,
+        help="Spike detector: rolling window of recent GOOD per-step "
+        "losses the median/MAD baseline is computed over",
+    )
+    parser.add_argument(
+        "--health-spike-mads",
+        type=float,
+        default=8.0,
+        help="Spike detector: a step flags as a spike when its loss "
+        "exceeds the rolling median by this many MADs",
+    )
+    parser.add_argument(
+        "--health-bad-steps",
+        type=int,
+        default=3,
+        help="Rollback trigger: K consecutive bad steps (skipped "
+        "non-finite or spiked) in an epoch roll the run back to the last "
+        "good checkpoint; fewer are absorbed (skips cost only the lost "
+        "update — the compiled guard already kept the state clean)",
+    )
+    parser.add_argument(
+        "--health-max-rollbacks",
+        type=int,
+        default=3,
+        help="Rollback budget per attempt: a fault that deterministically "
+        "re-fires on replay must abort loudly, not loop",
+    )
+    parser.add_argument(
+        "--health-desync-every",
+        type=int,
+        default=1,
+        help="Check cross-replica param fingerprints every N epochs "
+        "(0 disables); any mismatch rolls back — replicas that silently "
+        "drifted apart must never keep training",
+    )
+    parser.add_argument(
+        "--health-json",
+        type=str,
+        default=None,
+        help="Write the HEALTH.json summary (skip/spike/rollback/desync "
+        "counts + events) to this path at the end of the run; per-event "
+        "records always land in the run dir's health.jsonl",
+    )
     parser.add_argument(
         "--legacy-test-stats",
         action="store_true",
@@ -499,6 +558,20 @@ def load_config(
         parser.error(f"--limit-examples must be >= 0, got {args.limit_examples}")
     if args.max_restarts < 0:
         parser.error(f"--max-restarts must be >= 0, got {args.max_restarts}")
+    if args.health_window < 4:
+        parser.error(f"--health-window must be >= 4, got {args.health_window}")
+    if args.health_bad_steps < 1:
+        parser.error(
+            f"--health-bad-steps must be >= 1, got {args.health_bad_steps}"
+        )
+    if args.health_max_rollbacks < 0:
+        parser.error(
+            f"--health-max-rollbacks must be >= 0, got {args.health_max_rollbacks}"
+        )
+    if args.health_desync_every < 0:
+        parser.error(
+            f"--health-desync-every must be >= 0, got {args.health_desync_every}"
+        )
     if args.restart_backoff < 0:
         parser.error(f"--restart-backoff must be >= 0, got {args.restart_backoff}")
     if args.fault_plan:

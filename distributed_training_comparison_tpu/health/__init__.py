@@ -1,0 +1,59 @@
+"""Training-health watchdog: NaN/spike/desync detection with automatic rollback.
+
+PR 2 (``resilience/``) made *process death* recoverable; this package makes
+*silent training corruption* recoverable — the dominant failure mode at
+scale, where a run that hits NaN gradients, a loss spike from a corrupt
+batch, or replica drift keeps burning chips while training to garbage.
+
+Four cooperating layers, cheapest first:
+
+- ``guards``   — compiled numerics guards INSIDE the jitted step: per-step
+                 gradient global-norm + finite flags, and a guarded update
+                 that skips the optimizer apply on non-finite steps.  Zero
+                 extra device→host syncs: the flags ride the existing
+                 per-epoch metrics fetch.
+- ``spike``    — host-side rolling median/MAD spike detector over the
+                 per-step loss stream (robust to the loss's downward trend;
+                 a corrupt batch shows up as a multiple-MAD outlier).
+- ``desync``   — periodic cross-replica parameter fingerprint (per-leaf
+                 checksum reduced to one scalar) all-gathered across
+                 processes; replicas that silently drifted apart are caught
+                 before they poison checkpoints.
+- ``watchdog`` — the policy layer the Trainer polls once per epoch: skipped
+                 (non-finite) steps are absorbed for free; K *consecutive*
+                 bad steps or any desync trigger automatic rollback to the
+                 last good checkpoint via the ``resilience/ckpt_io`` verified
+                 restore, bounded by a rollback budget; every event feeds
+                 ``resilience/goodput`` (rollback waste is its own phase)
+                 and ``HEALTH.json``.
+
+Fault injection for all of it lives in ``resilience/faults.py`` (``nan_grad``,
+``bad_batch``, ``loss_spike``, ``desync`` plan events), so each detector has
+a deterministic, seeded detect→rollback→converge-anyway e2e test.
+"""
+
+from .desync import check_desync, gather_fingerprints, param_fingerprint
+from .guards import global_norm, select_tree, step_finite
+from .spike import SpikeDetector
+from .watchdog import (
+    EpochVerdict,
+    HealthConfig,
+    Watchdog,
+    load_health_events,
+    write_health,
+)
+
+__all__ = [
+    "check_desync",
+    "gather_fingerprints",
+    "param_fingerprint",
+    "global_norm",
+    "select_tree",
+    "step_finite",
+    "SpikeDetector",
+    "EpochVerdict",
+    "HealthConfig",
+    "Watchdog",
+    "load_health_events",
+    "write_health",
+]

@@ -1,0 +1,51 @@
+"""Compiled numerics guards — the in-jit half of the training-health watchdog.
+
+Everything here traces into the step program (``train/step.py``), so the
+happy path pays a handful of reductions fused into the backward pass and
+NOTHING on the host: the per-step ``grad_norm`` / ``skipped`` scalars ride
+the same stacked metrics fetch the loss already uses (one device→host
+round-trip per epoch, not per step).
+
+The guarded update is a whole-state ``where``: a non-finite step keeps the
+OLD params, BN statistics, optimizer state and step counter — a NaN batch
+costs one skipped update, never a poisoned state.  Skipping the step counter
+too keeps the LR schedule aligned with updates actually applied.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """L2 norm over every leaf of ``tree`` as one f32 scalar.
+
+    NaN anywhere → NaN out; Inf anywhere → Inf out (the square cannot
+    underflow back to finite) — so ``isfinite(global_norm(grads))`` is a
+    single-scalar "every gradient element is finite" test.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    )
+
+
+def step_finite(loss: jnp.ndarray, grad_norm: jnp.ndarray) -> jnp.ndarray:
+    """The skip decision: a step applies iff its loss AND its gradient norm
+    are finite.  Deliberately computed from these two scalars ONLY — sown
+    diagnostics (MoE dispatch metrics etc.) may carry NaN without vetoing an
+    otherwise-healthy update (a NaN *auxiliary loss* still trips the guard,
+    because it is summed into ``loss`` itself)."""
+    return jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+
+
+def select_tree(pred: jnp.ndarray, on_true, on_false):
+    """Per-leaf ``where(pred, on_true, on_false)`` over two same-shaped
+    pytrees — the guarded-update primitive (`pred` is the scalar finite
+    flag; trees are the candidate and current ``TrainState``)."""
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false
+    )

@@ -1,0 +1,72 @@
+"""Rolling median/MAD loss-spike detector (host-side, numpy).
+
+A corrupt batch, a bad learning-rate interaction, or upstream data damage
+shows up as a per-step loss far outside the recent distribution long before
+it shows up in epoch averages.  Mean/stddev are the wrong tools on a stream
+that (a) trends downward and (b) contains the very outliers being hunted;
+median and MAD (median absolute deviation) are robust to both.
+
+The window is a stream across epochs (losses arrive one epoch at a time via
+the stacked per-epoch fetch), holds only steps judged GOOD — flagged spikes
+and skipped (non-finite) steps are excluded, so one spike cannot inflate
+the MAD and mask the next — and requires ``min_baseline`` samples before
+flagging anything (early-training chaos must not trigger rollbacks).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+# MAD floor: identical-loss windows (tiny synthetic data) have MAD 0, which
+# would flag any fluctuation; the floor is relative to the median so it
+# scales from CIFAR CE (~4.6) to tiny regression losses alike.
+_MAD_FLOOR_FRAC = 0.05
+_MAD_FLOOR_ABS = 1e-3
+
+
+class SpikeDetector:
+    """Flags per-step losses more than ``threshold_mads`` MADs above the
+    rolling median of the last ``window`` good steps."""
+
+    def __init__(
+        self,
+        window: int = 64,
+        threshold_mads: float = 8.0,
+        min_baseline: int = 16,
+    ) -> None:
+        if window < 4:
+            raise ValueError(f"window must be >= 4, got {window}")
+        self.window: deque[float] = deque(maxlen=window)
+        self.threshold_mads = float(threshold_mads)
+        # a baseline larger than the window could never fill: clamp, so a
+        # small --health-window (short CI epochs) still arms the detector
+        self.min_baseline = min(int(min_baseline), window)
+
+    def cutoff(self) -> float | None:
+        """The current spike threshold, or None while the baseline fills."""
+        if len(self.window) < self.min_baseline:
+            return None
+        arr = np.asarray(self.window)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        mad = max(mad, _MAD_FLOOR_ABS, _MAD_FLOOR_FRAC * abs(med))
+        return med + self.threshold_mads * mad
+
+    def observe(self, losses: np.ndarray, skipped: np.ndarray) -> np.ndarray:
+        """Consume one epoch's per-step losses; returns a bool spike flag per
+        step.  ``skipped`` marks steps the compiled guard already rejected
+        (non-finite) — they are never spikes and never enter the window."""
+        losses = np.asarray(losses, np.float64)
+        skipped = np.asarray(skipped) > 0.5
+        flags = np.zeros(len(losses), bool)
+        for i, loss in enumerate(losses):
+            if skipped[i] or not np.isfinite(loss):
+                continue
+            cut = self.cutoff()
+            if cut is not None and loss > cut:
+                flags[i] = True
+                continue  # outliers stay out of their own baseline
+            self.window.append(float(loss))
+        return flags

@@ -9,16 +9,44 @@ always produces the same failures, so a recovery bug reproduces.
 Spec syntax (``;``- or ``,``-separated events)::
 
     preempt@epoch=2            # injected preemption at the END of epoch 2
+    preempt@epoch=2:step=40    # MID-epoch preemption once 40 steps are done
+                               # (host data mode polls chunk boundaries;
+                               # device mode fires at the epoch boundary)
     ckpt_fail@epoch=1          # epoch 1's last.ckpt write raises OSError
     torn_write@epoch=1         # epoch 1's last.ckpt is torn AFTER landing
     stall@epoch=0:secs=0.5     # 0.5 s step-time stall after epoch 0
     preempt@prob=0.1           # seeded per-epoch Bernoulli alternative
+
+Training-health faults (the watchdog's test harness, ``health/``)::
+
+    nan_grad@epoch=1                      # NaN loss+grads on steps [0, 3)
+    nan_grad@epoch=1:step=4:steps=2       # ... on steps [4, 6)
+    loss_spike@epoch=2                    # 64x loss/grad spike, 3 steps
+                                          # starting mid-epoch
+    loss_spike@epoch=2:scale=100:steps=5  # tunable magnitude/width
+    bad_batch@epoch=1                     # ONE Inf step (a corrupt batch):
+                                          # skipped by the compiled guard,
+                                          # absorbed without rollback
+    desync@epoch=1                        # simulated replica drift in the
+                                          # param-fingerprint check
+
+Step faults inject through the compiled step's ``fault_scale`` seam
+(``train/step.py``): the loss metric and the gradients of the targeted
+steps are multiplied by ``scale`` (NaN/Inf scales exercise the non-finite
+guard, large finite scales the spike detector).  They are **one-shot per
+process by consumption**: ``step_fault``/``desync_due`` mark the event
+consumed when fetched, so a watchdog rollback replays the offending epoch
+*clean* — modeling transient corruption (a flaky data server read) rather
+than a persistent one, which the rollback budget bounds instead.
 
 ``epoch=K`` events whose effect lands AFTER epoch K's checkpoint
 (``preempt``, ``torn_write``, ``stall``) are one-shot across restarts *by
 construction*: the supervisor relaunches with ``--auto-resume``, training
 resumes past epoch K, the trigger condition is never true again, and the
 run completes — no need to strip the fault plan from the restart command.
+A mid-epoch ``preempt`` (``step=S``) is one-shot the same way: the drain
+records the steps already done, the relaunch fast-forwards past them, and
+``preempt_step_due`` only fires for steps trained in THIS attempt.
 ``ckpt_fail@epoch=K`` is the deliberate exception: it blocks epoch K's
 save, so a restart resumes at-or-before K and the fault re-fires — the
 persistent-write-failure scenario (a genuinely dying disk), which the
@@ -33,7 +61,19 @@ import random
 from dataclasses import dataclass, field
 from pathlib import Path
 
-KINDS = ("preempt", "ckpt_fail", "torn_write", "stall")
+KINDS = (
+    "preempt", "ckpt_fail", "torn_write", "stall",
+    "nan_grad", "bad_batch", "loss_spike", "desync",
+)
+# faults injected through the compiled step's fault_scale seam
+STEP_KINDS = ("nan_grad", "bad_batch", "loss_spike")
+
+_SCALE_DEFAULTS = {
+    "nan_grad": float("nan"),
+    "loss_spike": 64.0,
+    "bad_batch": float("inf"),
+}
+_STEPS_DEFAULTS = {"nan_grad": 3, "loss_spike": 3, "bad_batch": 1}
 
 
 class FaultSpecError(ValueError):
@@ -46,6 +86,11 @@ class FaultEvent:
     epoch: int | None = None   # fire at the end of exactly this epoch
     prob: float | None = None  # or: per-epoch Bernoulli at this rate
     secs: float = 0.0          # stall duration
+    step: int | None = None    # within-epoch step offset (step faults /
+                               # mid-epoch preempt)
+    steps: int | None = None   # step-fault width (defaults per kind)
+    scale: float | None = None # step-fault multiplier (defaults per kind)
+    consumed: bool = field(default=False, compare=False)
 
     def due(self, epoch: int, seed: int) -> bool:
         if self.epoch is not None:
@@ -60,7 +105,8 @@ class FaultEvent:
 
 @dataclass
 class FaultPlan:
-    """A parsed fault plan; the Trainer polls it at epoch boundaries."""
+    """A parsed fault plan; the Trainer polls it at epoch (and, for
+    step-granular events, chunk) boundaries."""
 
     events: list[FaultEvent] = field(default_factory=list)
     seed: int = 0
@@ -94,10 +140,16 @@ class FaultPlan:
                         kwargs["prob"] = float(val)
                     elif key == "secs":
                         kwargs["secs"] = float(val)
+                    elif key == "step":
+                        kwargs["step"] = int(val)
+                    elif key == "steps":
+                        kwargs["steps"] = int(val)
+                    elif key == "scale":
+                        kwargs["scale"] = float(val)
                     else:
                         raise FaultSpecError(
                             f"unknown fault arg {key!r} in {item!r} "
-                            "(known: epoch, prob, secs)"
+                            "(known: epoch, prob, secs, step, steps, scale)"
                         )
                 except ValueError as e:
                     if isinstance(e, FaultSpecError):
@@ -115,13 +167,80 @@ class FaultPlan:
     def _due(self, kind: str, epoch: int) -> list[FaultEvent]:
         return [e for e in self.events if e.kind == kind and e.due(epoch, self.seed)]
 
-    def preempt_due(self, epoch: int) -> bool:
-        """Injected preemption fires at the end of ``epoch``."""
-        return bool(self._due("preempt", epoch))
+    def preempt_due(self, epoch: int, include_step_events: bool = True) -> bool:
+        """Injected preemption fires at the end of ``epoch``.
+
+        ``include_step_events=False`` excludes ``step=S`` events — the host
+        data mode handles those mid-epoch via ``preempt_step_due`` and must
+        not double-fire them at the boundary; device mode (where the epoch
+        is one device program) keeps them, firing at the boundary instead.
+        """
+        return any(
+            include_step_events or e.step is None
+            for e in self._due("preempt", epoch)
+        )
+
+    def preempt_step_due(
+        self, epoch: int, done: int, start_offset: int = 0, cap: int | None = None
+    ) -> bool:
+        """A mid-epoch (``step=S``) preemption is pending once ``done`` steps
+        of ``epoch`` have completed.  ``start_offset`` is the step this
+        attempt resumed at: an event only fires if its step was actually
+        trained in THIS attempt (``start_offset < S <= done``), which makes
+        mid-epoch preempts one-shot across restarts — the relaunch resumes
+        at-or-past S and never re-fires it.  ``cap`` (the epoch's step
+        count) clamps an out-of-range S so it fires at the epoch boundary
+        instead of silently never."""
+        for e in self._due("preempt", epoch):
+            if e.step is None:
+                continue
+            step = min(e.step, cap) if cap is not None else e.step
+            # step=0 means "as soon as possible": clamp to 1 so the window
+            # test can ever pass (0 < 0 never fires)
+            if start_offset < max(step, 1) <= done:
+                return True
+        return False
 
     def stall_secs(self, epoch: int) -> float:
         """Total injected step-time stall after ``epoch`` (0.0 = none)."""
         return sum(e.secs for e in self._due("stall", epoch))
+
+    def has_step_faults(self) -> bool:
+        """Any ``nan_grad``/``bad_batch``/``loss_spike`` events in the plan?
+        The Trainer builds the fault-injection runner variant only then."""
+        return any(e.kind in STEP_KINDS for e in self.events)
+
+    def step_fault(self, epoch: int, steps_per_epoch: int) -> tuple[float, int, int]:
+        """The ``(scale, start, stop)`` step-fault window for ``epoch``, or
+        the benign ``(1.0, 0, 0)``.  Consumes the first due unconsumed event
+        (one-shot per process): a watchdog rollback re-running this epoch
+        gets a clean pass.  Defaults: ``nan_grad`` poisons the first 3
+        steps; ``loss_spike``/``bad_batch`` start mid-epoch (so the spike
+        detector has a baseline window) with 3 / 1 step(s) at 64x / Inf.
+        """
+        for e in self.events:
+            if e.kind not in STEP_KINDS or e.consumed or not e.due(epoch, self.seed):
+                continue
+            e.consumed = True
+            if e.step is not None:
+                start = e.step
+            elif e.kind == "nan_grad":
+                start = 0
+            else:
+                start = steps_per_epoch // 2
+            count = e.steps if e.steps else _STEPS_DEFAULTS[e.kind]
+            scale = e.scale if e.scale is not None else _SCALE_DEFAULTS[e.kind]
+            return (scale, start, min(start + count, steps_per_epoch))
+        return (1.0, 0, 0)
+
+    def desync_due(self, epoch: int) -> bool:
+        """An injected replica-desync fires after ``epoch`` (one-shot by
+        consumption, so the rollback replay's re-check passes)."""
+        for e in self.events:
+            if e.kind == "desync" and not e.consumed and e.due(epoch, self.seed):
+                e.consumed = True
+                return True
+        return False
 
     def ckpt_hook(self, epoch: int):
         """A write-fault hook for this epoch's resumable save, or None.

@@ -13,11 +13,18 @@ actually training.  The meter splits a process's lifetime into phases —
   overlap is the design, and charging it would double-book time the chip
   spent stepping,
 - ``stall`` — injected or detected step-time stalls,
+- ``rollback`` — step time the health watchdog later invalidated: when a
+  bad epoch rolls back to the last good checkpoint (``health/``), its
+  wall-clock moves from ``step`` to here via ``transfer`` — wasted compute
+  must not inflate goodput,
 
 plus untracked remainder.  Each training attempt appends one record to the
 run dir's ``goodput.jsonl``; the supervisor (or ``bench.py --resilience``)
 aggregates records + its own restart downtime into ``GOODPUT.json`` —
 goodput = productive seconds / (wall seconds across attempts + downtime).
+Attempt records may also carry a ``ckpt_writer`` gauge (the async writer
+thread's busy seconds/fraction, ``train/async_ckpt.py``) — visible when
+write-behind stops hiding the device→host fetch cost.
 """
 
 from __future__ import annotations
@@ -28,7 +35,7 @@ from collections import defaultdict
 from contextlib import contextmanager
 from pathlib import Path
 
-PHASES = ("init", "step", "eval", "ckpt", "stall")
+PHASES = ("init", "step", "eval", "ckpt", "stall", "rollback")
 
 
 class GoodputMeter:
@@ -41,6 +48,16 @@ class GoodputMeter:
 
     def add(self, phase: str, secs: float) -> None:
         self.seconds[phase] += max(0.0, float(secs))
+
+    def transfer(self, src: str, dst: str, secs: float) -> float:
+        """Re-attribute up to ``secs`` already booked under ``src`` to
+        ``dst`` (health rollback: a bad epoch's 'step' time becomes
+        'rollback' waste once invalidated).  Clamped to what ``src``
+        actually holds; returns the amount moved."""
+        moved = min(max(0.0, float(secs)), self.seconds[src])
+        self.seconds[src] -= moved
+        self.seconds[dst] += moved
+        return moved
 
     @contextmanager
     def phase(self, name: str):
@@ -124,9 +141,14 @@ def aggregate_goodput(
     totals = {f"{k}_s": 0.0 for k in PHASES}
     totals["wall_s"] = 0.0
     totals["untracked_s"] = 0.0
+    writer_busy = 0.0
+    health = {"skipped_steps": 0, "spike_steps": 0, "rollbacks": 0, "desyncs": 0}
     for rec in records:
         for key in totals:
             totals[key] += float(rec.get(key, 0.0))
+        writer_busy += float(rec.get("ckpt_writer", {}).get("busy_s", 0.0))
+        for key in health:
+            health[key] += int(rec.get("health", {}).get(key, 0))
     total_wall = totals["wall_s"] + downtime_s
     goodput = totals["step_s"] / total_wall if total_wall > 0 else 0.0
     return {
@@ -140,6 +162,8 @@ def aggregate_goodput(
         "attempts": len(records),
         "phase_totals_s": {k: round(totals[f"{k}_s"], 3) for k in PHASES},
         "untracked_s": round(totals["untracked_s"], 3),
+        "ckpt_writer_busy_s": round(writer_busy, 3),
+        "health": health,
         "attempt_records": records,
     }
 

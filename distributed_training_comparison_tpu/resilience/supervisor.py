@@ -36,6 +36,16 @@ class Supervisor:
     hook the elastic tests use to change the forced device count between
     attempts, and a real deployment would use to re-render the launch
     command for a resized slice.
+
+    ``progress`` (optional) is a zero-arg probe returning an opaque marker
+    of the run's durable progress (typically the newest valid checkpoint's
+    path + step).  A crashed attempt whose marker MOVED — e.g. the health
+    watchdog rolled back, wrote checkpoints, and only then exhausted its
+    budget — made real progress: it does not consume the restart budget and
+    resets the exponential crash backoff, so a run that keeps advancing
+    through repeated spikes is never starved of restarts, while a run stuck
+    at the same checkpoint still exhausts ``max_restarts``.  Preemptions
+    keep their PR-2 semantics (immediate relaunch, budget consumed).
     """
 
     def __init__(
@@ -50,6 +60,7 @@ class Supervisor:
         runner: Callable[[Sequence[str], dict | None], int] | None = None,
         sleep: Callable[[float], None] = time.sleep,
         log: Callable[[str], None] | None = None,
+        progress: Callable[[], object] | None = None,
     ) -> None:
         self._cmd = cmd
         self._env = env
@@ -60,6 +71,7 @@ class Supervisor:
         self._runner = runner or _default_runner
         self._sleep = sleep
         self._log = log or (lambda msg: print(f"[supervisor] {msg}", file=sys.stderr))
+        self._progress = progress
 
     def _resolve(self, attempt: int) -> tuple[list[str], dict | None]:
         cmd = self._cmd(attempt) if callable(self._cmd) else self._cmd
@@ -76,8 +88,11 @@ class Supervisor:
         attempts: list[dict] = []
         crashes = 0
         preemptions = 0
+        progress_restarts = 0
+        budget_used = 0
         downtime = 0.0
         attempt = 0
+        prev_marker = self._progress() if self._progress is not None else None
         while True:
             cmd, env = self._resolve(attempt)
             t0 = time.monotonic()
@@ -94,31 +109,47 @@ class Supervisor:
             )
             if rc == 0:
                 break
+            progressed = False
+            if self._progress is not None:
+                marker = self._progress()
+                progressed = marker is not None and marker != prev_marker
+                prev_marker = marker
+                attempts[-1]["progress"] = progressed
             if preempted:
                 # counted before the budget check so a final preempted
                 # attempt that exhausts the budget still shows up
                 preemptions += 1
-            restarts_used = len(attempts) - 1
-            if restarts_used >= self.max_restarts:
+                budget_used += 1
+            elif progressed:
+                # the attempt advanced the durable checkpoint (e.g. health
+                # rollbacks kept writing progress before the budget ran
+                # out): a free restart, and the crash backoff restarts from
+                # its base instead of compounding
+                progress_restarts += 1
+                crashes = 0
+            else:
+                budget_used += 1
+            if budget_used > self.max_restarts:
                 self._log(
-                    f"giving up after {restarts_used} restarts (last rc={rc})"
+                    f"giving up after {len(attempts) - 1} restarts (last rc={rc})"
                 )
                 break
             if preempted:
                 # the machine went away, not the code: relaunch immediately
                 self._log(
                     f"attempt {attempt} preempted (rc={rc}); relaunching "
-                    f"with --auto-resume ({restarts_used + 1}/{self.max_restarts})"
+                    f"with --auto-resume ({budget_used}/{self.max_restarts})"
                 )
             else:
                 crashes += 1
                 backoff = min(
                     self.backoff_max, self.backoff_base * 2 ** (crashes - 1)
                 )
+                note = " (checkpoint progressed: budget spared, backoff reset)" if progressed else ""
                 self._log(
                     f"attempt {attempt} failed (rc={rc}); backing off "
                     f"{backoff:.1f}s then restarting "
-                    f"({restarts_used + 1}/{self.max_restarts})"
+                    f"({budget_used}/{self.max_restarts}){note}"
                 )
                 self._sleep(backoff)
                 downtime += backoff
@@ -127,6 +158,7 @@ class Supervisor:
             "final_rc": attempts[-1]["returncode"],
             "restarts": len(attempts) - 1,
             "preemptions": preemptions,
+            "progress_restarts": progress_restarts,
             "downtime_s": round(downtime, 3),
             "attempts": attempts,
         }
@@ -184,10 +216,23 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
                 args = strip_resume_flag(child_args)
         return [sys.executable, sys.argv[0]] + args
 
+    def progress_probe():
+        # durable-progress marker: the newest valid checkpoint's identity
+        # (path + manifest checksum/step — manifest-only, so probing a
+        # multi-GB state between attempts costs ~KB, not a full read+hash).
+        # A crashed attempt that moved it (health rollbacks kept writing
+        # last.ckpt before the in-process budget ran out) restarts for free
+        # — repeated spikes must not exhaust --max-restarts while epochs
+        # still advance.
+        from ..train.checkpoint import resume_progress_marker  # lazy: avoid cycle
+
+        return resume_progress_marker(hparams.ckpt_path)
+
     sup = Supervisor(
         cmd_for,
         max_restarts=getattr(hparams, "max_restarts", 3),
         backoff_base=getattr(hparams, "restart_backoff", 1.0),
+        progress=progress_probe,
     )
     t_start = time.time()
     summary = sup.run()

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable
 
 
@@ -41,6 +42,8 @@ class AsyncCheckpointer:
         self._latest: dict[str, Callable[[], object] | None] = {}
         self._lock = threading.Lock()
         self._errors: list[BaseException] = []
+        self._busy_s = 0.0  # wall-clock the worker spent executing jobs
+        self._born = time.monotonic()
         self._thread = threading.Thread(
             target=self._worker, name="dtc-ckpt-writer", daemon=True
         )
@@ -56,6 +59,7 @@ class AsyncCheckpointer:
             with self._lock:
                 job = self._latest.get(key)
                 self._latest[key] = None
+            t0 = time.monotonic()
             try:
                 if job is not None:  # None => superseded, already written
                     job()
@@ -63,7 +67,24 @@ class AsyncCheckpointer:
                 with self._lock:
                     self._errors.append(e)
             finally:
+                with self._lock:
+                    self._busy_s += time.monotonic() - t0
                 self._q.task_done()
+
+    def stats(self) -> dict:
+        """Writer-thread utilization gauge for goodput records: busy seconds
+        (fetch+serialize+write inside jobs) over thread lifetime.  A busy
+        fraction approaching 1.0 means write-behind has stopped hiding the
+        checkpoint cost — saves are queueing faster than they drain, and the
+        next ``wait()`` will block the epoch loop for real."""
+        alive = max(time.monotonic() - self._born, 1e-9)
+        with self._lock:
+            busy = self._busy_s
+        return {
+            "busy_s": round(busy, 4),
+            "alive_s": round(alive, 4),
+            "busy_frac": round(min(busy / alive, 1.0), 4),
+        }
 
     def submit(self, job: Callable[[], object], key: str = "default") -> None:
         """Enqueue a checkpoint job; newer jobs with the same key supersede

@@ -29,6 +29,7 @@ from ..parallel.sharding import fetch_to_host
 from ..resilience.ckpt_io import (
     atomic_write_bytes,
     previous_path,
+    read_manifest,
     rotate_previous,
     verify_checkpoint,
     write_manifest,
@@ -190,6 +191,31 @@ def find_latest_resume(ckpt_root: str | Path) -> Path | None:
     return path if path.exists() else None
 
 
+def valid_resume_bytes_in(version_dir: str | Path) -> tuple[Path, bytes] | None:
+    """THIS version dir's ``last.ckpt`` if its integrity manifest checks
+    out, else the rotated ``prev-last.ckpt``, else None — with the verified
+    payload bytes (one disk read serves verify + restore).
+
+    Shared by --auto-resume discovery (newest dir) and the health
+    watchdog's rollback (the CURRENT run's dir): both must only ever hand
+    back a state whose bytes verified."""
+    newest = Path(version_dir) / LAST_NAME
+    for candidate in (newest, previous_path(newest)):
+        if not candidate.exists():
+            continue
+        data = candidate.read_bytes()
+        ok, reason = verify_checkpoint(candidate, data=data)
+        if ok:
+            if candidate != newest:
+                _log.warning(
+                    f"resume: {newest.name} failed verification; falling "
+                    f"back to previous good checkpoint {candidate.name}"
+                )
+            return candidate, data
+        _log.warning(f"resume: rejecting {candidate}: {reason}")
+    return None
+
+
 def find_valid_resume_bytes(ckpt_root: str | Path) -> tuple[Path, bytes] | None:
     """Verify-on-restore discovery: the newest version dir's ``last.ckpt``
     only if its integrity manifest checks out, else the rotated previous
@@ -204,27 +230,41 @@ def find_valid_resume_bytes(ckpt_root: str | Path) -> tuple[Path, bytes] | None:
     dirs = _version_dirs_newest_first(ckpt_root)
     if not dirs:
         return None
-    newest = dirs[0] / LAST_NAME
-    for candidate in (newest, previous_path(newest)):
-        if not candidate.exists():
-            continue
-        data = candidate.read_bytes()
-        ok, reason = verify_checkpoint(candidate, data=data)
-        if ok:
-            if candidate != newest:
-                _log.warning(
-                    f"auto-resume: {newest.name} failed verification; "
-                    f"falling back to previous good checkpoint {candidate.name}"
-                )
-            return candidate, data
-        _log.warning(f"auto-resume: rejecting {candidate}: {reason}")
-    return None
+    return valid_resume_bytes_in(dirs[0])
 
 
 def find_valid_resume(ckpt_root: str | Path) -> Path | None:
     """Path-only form of ``find_valid_resume_bytes``."""
     hit = find_valid_resume_bytes(ckpt_root)
     return hit[0] if hit else None
+
+
+def resume_progress_marker(ckpt_root: str | Path) -> tuple | None:
+    """A cheap durable-progress marker for the newest resumable checkpoint:
+    its path plus the manifest's checksum/step/epoch fields.  Manifest-only
+    — a size (shallow) verification, NO payload read or hash — so the
+    supervisor can probe it between attempts at ~KB cost even for multi-GB
+    states (the child's --auto-resume still deep-verifies before actually
+    restoring).  None when no size-valid checkpoint exists."""
+    dirs = _version_dirs_newest_first(ckpt_root)
+    if not dirs:
+        return None
+    newest = dirs[0] / LAST_NAME
+    for candidate in (newest, previous_path(newest)):
+        if not candidate.exists():
+            continue
+        ok, _ = verify_checkpoint(candidate, deep=False)
+        if not ok:
+            continue
+        manifest = read_manifest(candidate) or {}
+        return (
+            str(candidate),
+            manifest.get("sha256"),
+            manifest.get("step"),
+            manifest.get("epoch"),
+            manifest.get("epoch_steps_done"),
+        )
+    return None
 
 
 def _best_sort_key(path: Path) -> tuple[int, float]:

@@ -39,6 +39,7 @@ from jax.sharding import Mesh
 from ..data.augment import normalize_images, random_crop_flip
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD
 from ..data.sampler import epoch_permutation
+from ..health.guards import global_norm, select_tree, step_finite
 from ..parallel.sharding import batch_sharding, replicated_sharding
 from .state import TrainState
 
@@ -107,6 +108,18 @@ def _make_step_core(
     augmentation/normalization prologue and the optimizer epilogue are
     shared either way.  Only BN-free models are eligible (the hook carries
     no batch-stats plumbing).
+
+    The epilogue carries the compiled numerics guards (``health/guards.py``):
+    every step computes the gradient global-norm and a finite flag in-jit,
+    and a non-finite step SKIPS the optimizer apply entirely (params, BN
+    stats, optimizer state and step counter all keep their old values) —
+    the ``grad_norm`` / ``skipped`` metrics ride the existing stacked
+    fetch, so the happy path pays no extra device→host sync.  ``core``'s
+    optional trailing ``fault_scale`` is the fault-injection seam
+    (``resilience/faults.py`` step faults): when traced in, it multiplies
+    both the loss metric and the gradients — NaN/Inf scales exercise the
+    guard, large finite scales exercise the spike detector — and costs
+    nothing when absent (the default ``None`` traces no fault ops at all).
     """
     compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
@@ -156,64 +169,70 @@ def _make_step_core(
         extras = _moe_health(mutated.get("moe_metrics", {}))
         return grads, new_stats, loss, top1.sum(), extras
 
-    def core(state: TrainState, images, labels, key: jax.Array):
+    def core(state: TrainState, images, labels, key: jax.Array, fault_scale=None):
         if grad_accum <= 1:
             grads, new_stats, loss, top1_count, extras = forward_backward(
                 state.params, state.apply_fn, state.batch_stats, images, labels, key
             )
-            state = state.apply_gradients(grads=grads, batch_stats=new_stats)
-            return state, {
-                "loss": loss,
-                "top1_count": top1_count,
-                "count": labels.size,
-                **extras,
+        else:
+            a = grad_accum
+            b = images.shape[0]
+            micro_images = images.reshape(a, b // a, *images.shape[1:])
+            micro_labels = labels.reshape(a, b // a)
+            if accum_sharding is not None:
+                # pin each micro-batch to the data axis: GSPMD otherwise
+                # resolves the unconstrained reshape by REPLICATING every
+                # micro-batch to all devices — each chip would redundantly
+                # compute the full micro-batch and data parallelism is lost
+                micro_images = jax.lax.with_sharding_constraint(
+                    micro_images, accum_sharding
+                )
+                micro_labels = jax.lax.with_sharding_constraint(
+                    micro_labels, accum_sharding
+                )
+            micro_keys = jax.random.split(key, a)
+
+            def micro_step(carry, inp):
+                grads_sum, batch_stats = carry
+                bx, by, k = inp
+                grads, new_stats, loss, top1_count, extras = forward_backward(
+                    state.params, state.apply_fn, batch_stats, bx, by, k
+                )
+                grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
+                return (grads_sum, new_stats), {
+                    "loss": loss, "top1": top1_count, **extras
+                }
+
+            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            (grads_sum, new_stats), stacked = jax.lax.scan(
+                micro_step,
+                (zero_grads, state.batch_stats),
+                (micro_images, micro_labels, micro_keys),
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / a, grads_sum)
+            loss = stacked["loss"].mean()
+            top1_count = stacked["top1"].sum()
+            extras = {
+                k: stacked[k].mean() for k in stacked if k.startswith("moe_")
             }
 
-        a = grad_accum
-        b = images.shape[0]
-        micro_images = images.reshape(a, b // a, *images.shape[1:])
-        micro_labels = labels.reshape(a, b // a)
-        if accum_sharding is not None:
-            # pin each micro-batch to the data axis: GSPMD otherwise
-            # resolves the unconstrained reshape by REPLICATING every
-            # micro-batch to all devices — each chip would redundantly
-            # compute the full micro-batch and data parallelism is lost
-            micro_images = jax.lax.with_sharding_constraint(
-                micro_images, accum_sharding
-            )
-            micro_labels = jax.lax.with_sharding_constraint(
-                micro_labels, accum_sharding
-            )
-        micro_keys = jax.random.split(key, a)
+        if fault_scale is not None:
+            loss = loss * fault_scale
+            grads = jax.tree_util.tree_map(lambda g: g * fault_scale, grads)
 
-        def micro_step(carry, inp):
-            grads_sum, batch_stats = carry
-            bx, by, k = inp
-            grads, new_stats, loss, top1_count, extras = forward_backward(
-                state.params, state.apply_fn, batch_stats, bx, by, k
-            )
-            grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
-            return (grads_sum, new_stats), {
-                "loss": loss, "top1": top1_count, **extras
-            }
-
-        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-        (grads_sum, final_stats), stacked = jax.lax.scan(
-            micro_step,
-            (zero_grads, state.batch_stats),
-            (micro_images, micro_labels, micro_keys),
-        )
-        grads = jax.tree_util.tree_map(lambda g: g / a, grads_sum)
-        state = state.apply_gradients(grads=grads, batch_stats=final_stats)
+        # compiled numerics guards: a non-finite step keeps the ENTIRE old
+        # state (the skipped update costs one batch, never a poisoned run)
+        grad_norm = global_norm(grads)
+        finite = step_finite(loss, grad_norm)
+        new_state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+        state = select_tree(finite, new_state, state)
         return state, {
-            "loss": stacked["loss"].mean(),
-            "top1_count": stacked["top1"].sum(),
+            "loss": loss,
+            "top1_count": top1_count,
             "count": labels.size,
-            **{
-                k: stacked[k].mean()
-                for k in stacked
-                if k.startswith("moe_")
-            },
+            "grad_norm": grad_norm,
+            "skipped": 1.0 - finite.astype(jnp.float32),
+            **extras,
         }
 
     return core
@@ -343,6 +362,19 @@ def make_eval_runner(
     return jax.jit(run, out_shardings=repl)
 
 
+def _step_fault_scale(i, fault):
+    """Per-step fault multiplier from a ``(scale, start, stop)`` plan tuple:
+    ``scale`` on steps in ``[start, stop)``, exactly 1.0 elsewhere (the
+    multiply-by-one is IEEE-exact, so a benign tuple leaves the trajectory
+    untouched)."""
+    scale, start, stop = fault
+    return jnp.where(
+        (i >= start) & (i < stop),
+        jnp.asarray(scale, jnp.float32),
+        jnp.float32(1.0),
+    )
+
+
 def make_chunk_runner(
     mesh: Mesh,
     *,
@@ -353,6 +385,7 @@ def make_chunk_runner(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    fault_injection: bool = False,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """K loader steps as ONE compiled ``lax.scan`` dispatch (host streaming).
 
@@ -367,26 +400,40 @@ def make_chunk_runner(
     bit-identical for ANY chunk size (chunk=1 reproduces the plain per-step
     path exactly).  One executable per distinct K (at most two per run: the
     full chunk and the remainder).
+
+    ``fault_injection=True`` appends a traced ``(scale, start, stop)``
+    step-fault argument (indices are GLOBAL within the epoch, matching the
+    key fold) — built only when a fault plan carries step faults, so the
+    normal path's executable is byte-identical to before.
     """
     chunk_shard = batch_sharding(mesh, axis=1)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(precision, augment, mean, std, grad_accum, chunk_shard, fwd_bwd)
 
-    def run(state: TrainState, images, labels, epoch_key: jax.Array, start):
+    def _run(state: TrainState, images, labels, epoch_key: jax.Array, start, fault):
         def body(state, inp):
             k, bx, by = inp
-            return core(state, bx, by, jax.random.fold_in(epoch_key, start + k))
+            key = jax.random.fold_in(epoch_key, start + k)
+            if fault is None:
+                return core(state, bx, by, key)
+            return core(state, bx, by, key, _step_fault_scale(start + k, fault))
 
         ks = jnp.arange(images.shape[0])
         state, stacked = jax.lax.scan(body, state, (ks, images, labels))
         return state, stacked
 
-    return jax.jit(
-        run,
-        in_shardings=(state_sh, chunk_shard, chunk_shard, repl, repl),
-        out_shardings=(state_sh, repl),
-    )
+    if fault_injection:
+        run = lambda state, images, labels, epoch_key, start, fault: (  # noqa: E731
+            _run(state, images, labels, epoch_key, start, fault)
+        )
+        in_sh = (state_sh, chunk_shard, chunk_shard, repl, repl, (repl, repl, repl))
+    else:
+        run = lambda state, images, labels, epoch_key, start: (  # noqa: E731
+            _run(state, images, labels, epoch_key, start, None)
+        )
+        in_sh = (state_sh, chunk_shard, chunk_shard, repl, repl)
+    return jax.jit(run, in_shardings=in_sh, out_shardings=(state_sh, repl))
 
 
 def make_epoch_runner(
@@ -400,6 +447,7 @@ def make_epoch_runner(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    fault_injection: bool = False,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -408,6 +456,10 @@ def make_epoch_runner(
     executable).  Per-epoch shuffling is a device-side permutation folded
     from (key, epoch); ``drop_last=True`` semantics match the reference's
     train loader (``src/single/dataset.py:97``).
+
+    ``fault_injection=True`` appends a traced ``(scale, start, stop)``
+    step-fault argument (``resilience/faults.py`` step faults); the default
+    runner's signature and executable are unchanged.
     """
     data_shard = batch_sharding(mesh)
     accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
@@ -415,7 +467,7 @@ def make_epoch_runner(
     state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
 
-    def run(state: TrainState, images, labels, key: jax.Array, epoch):
+    def _run(state: TrainState, images, labels, key: jax.Array, epoch, fault):
         n = images.shape[0]
         steps = n // batch_size
         epoch_key = jax.random.fold_in(key, epoch)
@@ -424,13 +476,25 @@ def make_epoch_runner(
         step_keys = jax.random.split(jax.random.fold_in(epoch_key, 1), steps)
 
         def body(state, inp):
-            idx, step_key = inp
+            idx, step_key, i = inp
             bx = jax.lax.with_sharding_constraint(images[idx], data_shard)
             by = jax.lax.with_sharding_constraint(labels[idx], data_shard)
-            return core(state, bx, by, step_key)
+            if fault is None:
+                return core(state, bx, by, step_key)
+            return core(state, bx, by, step_key, _step_fault_scale(i, fault))
 
-        state, stacked = jax.lax.scan(body, state, (perm, step_keys))
+        state, stacked = jax.lax.scan(
+            body, state, (perm, step_keys, jnp.arange(steps))
+        )
         return state, stacked  # stacked["loss"]: (steps,) per-step losses
 
+    if fault_injection:
+        run = lambda state, images, labels, key, epoch, fault: (  # noqa: E731
+            _run(state, images, labels, key, epoch, fault)
+        )
+    else:
+        run = lambda state, images, labels, key, epoch: (  # noqa: E731
+            _run(state, images, labels, key, epoch, None)
+        )
     # No donation — see make_train_step note (async checkpoint overlap).
     return jax.jit(run, out_shardings=(state_sh, repl))

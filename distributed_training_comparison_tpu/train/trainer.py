@@ -38,6 +38,7 @@ import numpy as np
 from ..config import HOST_CHUNK_STEPS_DEFAULT, WORKERS_DEFAULT
 from ..data import HostLoader, PrefetchLoader, get_datasets
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
+from ..health import HealthConfig, Watchdog, check_desync, param_fingerprint, write_health
 from ..models import get_model
 from ..parallel import is_main_process, make_mesh, state_shardings
 from ..parallel.sharding import (
@@ -98,6 +99,12 @@ class Trainer:
         self.preempt_handler = None
         if getattr(hparams, "resilience", False) or self.fault_plan is not None:
             self.preempt_handler = PreemptionHandler().install()
+        # step faults (nan_grad/bad_batch/loss_spike) trace an extra fault
+        # argument into the compiled runners; built only when the plan
+        # carries them so the normal executables are unchanged
+        self._step_faults = (
+            self.fault_plan is not None and self.fault_plan.has_step_faults()
+        )
         self.mesh = mesh if mesh is not None else make_mesh(
             hparams.num_devices, hparams.model_parallel, backend=hparams.backend
         )
@@ -327,6 +334,7 @@ class Trainer:
                 state_sharding=self.state_sharding,
                 grad_accum=self.grad_accum,
                 fwd_bwd=self.train_fwd_bwd,
+                fault_injection=self._step_faults,
             )
             self.chunk_runner = None
         else:
@@ -337,6 +345,7 @@ class Trainer:
                 state_sharding=self.state_sharding,
                 grad_accum=self.grad_accum,
                 fwd_bwd=self.train_fwd_bwd,
+                fault_injection=self._step_faults,
             )
         # whole-split scanned eval: one dispatch per validate()/test() call
         # (one executable per split shape), matching the train path's
@@ -425,6 +434,17 @@ class Trainer:
             int(self.version_dir.name.split("-")[1]) if self.version_dir else -1
         )
 
+        # mid-epoch resume (host data mode): a checkpoint drained at a chunk
+        # boundary records how many steps of the in-progress epoch it holds;
+        # the first epoch after restore fast-forwards past them (exact: the
+        # loader order and the per-step keys are functions of the global
+        # step index, not of where the attempt started)
+        self._resume_step_offset = 0
+        # watchdog rollback target of last resort: an explicit --resume runs
+        # in a FRESH version dir, so until its first save a bad early epoch
+        # would otherwise have nothing to roll back to — the (read-only)
+        # source checkpoint is exactly the state the run started from
+        self._rollback_source = getattr(hparams, "resume", None)
         if getattr(hparams, "resume", None):
             if resume_bytes is None:
                 # explicit --resume: read once, verify that buffer (a torn
@@ -452,11 +472,39 @@ class Trainer:
                 f"Resumed from {hparams.resume} at epoch {self.start_epoch} "
                 f"(best acc {self.best_acc:.4f})"
             )
-            elastic_msg = elastic.describe_restore(
-                read_manifest(hparams.resume), self.mesh
-            )
+            manifest = read_manifest(hparams.resume)
+            elastic_msg = elastic.describe_restore(manifest, self.mesh)
             if elastic_msg:
                 self.logger.info(elastic_msg)
+            if manifest and manifest.get("epoch_in_progress") == self.start_epoch:
+                steps_done = int(manifest.get("epoch_steps_done", 0))
+                if self.data_mode == "host":
+                    self._resume_step_offset = steps_done
+                    self.logger.info(
+                        f"mid-epoch resume: epoch {self.start_epoch} "
+                        f"fast-forwards past its first {steps_done} steps"
+                    )
+                elif steps_done:
+                    self.logger.warning(
+                        f"checkpoint was drained mid-epoch ({steps_done} "
+                        f"steps into epoch {self.start_epoch}) but device "
+                        "data mode runs whole epochs — those steps' updates "
+                        "are already in the restored state and the epoch "
+                        "will re-apply its full batch sequence"
+                    )
+        # --- training-health watchdog (health/): the compiled guards run
+        # unconditionally (a skipped NaN update is strictly better than an
+        # applied one); the watchdog adds spike/desync detection and the
+        # rollback policy.  --no-health keeps the bare abort-on-divergence.
+        self.watchdog = None
+        if getattr(hparams, "health", True):
+            self.watchdog = Watchdog(
+                HealthConfig.from_hparams(hparams), logger=self.logger
+            )
+        self._fingerprint_fn = None  # jitted lazily on first desync check
+        self._epoch_health: dict = {}
+        self._epoch_step_base = 0  # first global-within-epoch step trained
+
         # init/recovery cost: construction through restore + program builds
         # — the price every restart pays again, charged against goodput
         self._init_secs = time.monotonic() - self._t_construct
@@ -510,9 +558,9 @@ class Trainer:
             if hp.epoch - self.start_epoch > 1
             else self.start_epoch
         )
-        epochs = range(self.start_epoch, hp.epoch)
-        bar = self._progress_bar(epochs, desc="epochs")
-        for epoch in bar if bar is not None else epochs:
+        epoch = self.start_epoch
+        bar = self._progress_bar(range(self.start_epoch, hp.epoch), desc="epochs")
+        while epoch < hp.epoch:
             profiling = getattr(hp, "profile_dir", None) and epoch == profile_epoch
             if profiling:
                 jax.profiler.start_trace(hp.profile_dir)
@@ -526,42 +574,35 @@ class Trainer:
             if profiling:
                 jax.profiler.stop_trace()
                 self.logger.info(f"profiler trace written to {hp.profile_dir}")
-            imgs = self.steps_per_epoch * hp.batch_size
+            imgs = len(losses) * hp.batch_size
 
-            # failure detection (absent in the reference, SURVEY.md §5): a
-            # diverged run would otherwise burn the remaining epochs and
-            # poison every later checkpoint — stop at the first non-finite
-            # loss and point at the last good state
-            if not np.isfinite(losses).all():
-                bad = int(np.argmin(np.isfinite(losses)))
-                if self.ckpt_writer is not None:
-                    # drain in-flight best/last writes: the daemon writer
-                    # must not die mid-save when the exception exits.  A
-                    # failed earlier write is logged but must not replace
-                    # the divergence diagnostics below.
-                    try:
-                        self.ckpt_writer.wait()
-                    except Exception as e:
-                        self.logger.error(f"checkpoint writer error: {e}")
-                last_good = (
-                    self.version_dir / ckpt.LAST_NAME
-                    if self.version_dir is not None
-                    else None
-                )
-                if last_good is not None and not last_good.exists():
-                    last_good = None
-                msg = (
-                    f"non-finite train loss at epoch {epoch}, step {bad} "
-                    f"(global step {epoch * self.steps_per_epoch + bad}) — "
-                    f"aborting; last saved state: {last_good or 'none'}"
-                )
-                self.logger.error(msg)
-                raise FloatingPointError(msg)
+            # failure detection + recovery, BEFORE this epoch validates or
+            # checkpoints (a bad epoch must neither save its state nor be
+            # blessed as best).  With the watchdog on, sustained badness
+            # rolls back to the last good checkpoint and replays; with
+            # --no-health, the first non-finite loss aborts (pre-PR-3
+            # behavior — the compiled guard still kept the state clean).
+            if self.watchdog is not None:
+                rollback_to = self._health_check(epoch, losses, epoch_time)
+                if rollback_to is not None:
+                    epoch = rollback_to
+                    continue
+            elif not np.isfinite(losses).all() or (
+                np.asarray(self._epoch_health.get("skipped", ())) > 0.5
+            ).any():
+                # skipped steps mean non-finite grads: the guard held the
+                # state, but without the watchdog there is no recovery
+                # policy — abort exactly like the pre-guard divergence check
+                self._abort_nonfinite(epoch, losses)
 
+            step_base = self._epoch_step_base
             meter = AverageMeter()
             for i, loss in enumerate(losses):
-                gstep = epoch * self.steps_per_epoch + i
-                meter.update(float(loss))
+                gstep = epoch * self.steps_per_epoch + step_base + i
+                if np.isfinite(loss):
+                    # skipped (non-finite) steps applied no update; they are
+                    # counted by the watchdog, not averaged into the epoch
+                    meter.update(float(loss))
                 if (gstep + 1) % hp.eval_step == 0:
                     # instantaneous batch loss, like the reference's
                     # ``loss.item()`` line (src/single/trainer.py:150-153)
@@ -685,6 +726,11 @@ class Trainer:
                     self.goodput.add("stall", stall)
             if self._preempt_due(epoch):
                 self._preempt_exit(epoch, state_ref, want_last, sync_fetch)
+            epoch += 1
+            if bar is not None:
+                bar.update(1)
+        if bar is not None:
+            bar.close()
         if self.ckpt_writer is not None:
             with self.goodput.phase("ckpt"):
                 self.ckpt_writer.wait()
@@ -695,25 +741,281 @@ class Trainer:
         self._write_goodput()
         return self.version
 
+    # -------------------------------------------------------- training health
+
+    def _abort_nonfinite(self, epoch: int, losses, note: str = "") -> None:
+        """Divergence abort (absent in the reference, SURVEY.md §5): stop at
+        the first non-finite loss and point at the last good state — a
+        diverged run must not burn the remaining epochs or poison any later
+        checkpoint.  The guarded update already kept the in-memory state
+        clean; this is the loud exit when no recovery path remains."""
+        finite = np.isfinite(losses)
+        if not finite.all():
+            bad = int(np.argmin(finite))
+        else:
+            # finite losses but non-finite grads: point at the first step
+            # the compiled guard skipped
+            skipped = np.asarray(
+                self._epoch_health.get("skipped", np.zeros(len(losses)))
+            ) > 0.5
+            bad = int(np.argmax(skipped)) if skipped.any() else 0
+        if self.ckpt_writer is not None:
+            # drain in-flight best/last writes: the daemon writer must not
+            # die mid-save when the exception exits.  A failed earlier
+            # write is logged but must not replace the diagnostics below.
+            try:
+                self.ckpt_writer.wait()
+            except Exception as e:
+                self.logger.error(f"checkpoint writer error: {e}")
+        last_good = (
+            self.version_dir / ckpt.LAST_NAME
+            if self.version_dir is not None
+            else None
+        )
+        if last_good is not None and not last_good.exists():
+            last_good = None
+        msg = (
+            f"non-finite train loss/grads at epoch {epoch}, step {bad} "
+            f"(global step {epoch * self.steps_per_epoch + bad}){note} — "
+            f"aborting; last saved state: {last_good or 'none'}"
+        )
+        self.logger.error(msg)
+        raise FloatingPointError(msg)
+
+    def _health_check(self, epoch: int, losses, epoch_time: float) -> int | None:
+        """The watchdog's per-epoch verdict, BEFORE validation/checkpointing.
+
+        Returns the epoch to re-enter after a rollback, or None to proceed.
+        Every input to the decision (per-step losses, skip flags, gathered
+        fingerprints) is replicated/identical across processes, so under
+        multi-host every process reaches the same verdict and the rollback
+        collectives below run symmetrically.
+        """
+        skipped = np.asarray(
+            self._epoch_health.get("skipped", np.zeros(len(losses)))
+        )
+        verdict = self.watchdog.observe_epoch(epoch, np.asarray(losses), skipped)
+        if verdict.skipped:
+            self._log_tb("health/skipped_steps", verdict.skipped, epoch)
+            self.logger.warning(
+                f"health: {verdict.skipped} non-finite step(s) skipped in "
+                f"epoch {epoch} (guarded update held the state)"
+            )
+        if verdict.spikes:
+            self._log_tb("health/spike_steps", verdict.spikes, epoch)
+
+        desync = None
+        cfg = self.watchdog.cfg
+        inject = (
+            self.fault_plan.desync_due(epoch)
+            if self.fault_plan is not None
+            else False
+        )
+        if inject or (cfg.desync_every > 0 and (epoch + 1) % cfg.desync_every == 0):
+            desync = self._desync_check(inject)
+            if desync["mismatch"]:
+                self.watchdog.note_desync(epoch, desync)
+
+        reason = verdict.reason
+        if desync is not None and desync["mismatch"]:
+            reason = (
+                f"cross-replica desync (fingerprint spread "
+                f"{desync['spread']:.6g}"
+                + (", injected)" if desync["injected"] else ")")
+            )
+        if reason is None:
+            if self.is_main:
+                self.watchdog.flush_events(self.version_dir)
+            return None
+
+        self.logger.warning(f"health: rollback wanted at epoch {epoch}: {reason}")
+        if self.watchdog.exhausted():
+            if verdict.nonfinite or verdict.skipped:
+                self._abort_nonfinite(
+                    epoch, losses,
+                    note=f" after {self.watchdog.rollbacks} rollbacks",
+                )
+            raise RuntimeError(
+                f"health watchdog: rollback budget "
+                f"({cfg.max_rollbacks}) exhausted at epoch {epoch}: {reason}"
+            )
+        next_epoch = self._rollback(epoch, epoch_time, reason)
+        if next_epoch is None:  # nothing to roll back to
+            if verdict.nonfinite or verdict.skipped:
+                self._abort_nonfinite(
+                    epoch, losses, note=" (no rollback checkpoint exists)"
+                )
+            self.logger.error(
+                "health: no rollback checkpoint available; continuing "
+                "(spiked updates are already applied)"
+            )
+            if self.is_main:
+                self.watchdog.flush_events(self.version_dir)
+            return None
+        return next_epoch
+
+    def _desync_check(self, inject: bool) -> dict:
+        """Param fingerprint, all-gathered and compared across processes (a
+        COLLECTIVE under multi-host — reached identically by every process).
+        One scalar device→host read; see health/desync.py."""
+        if self._fingerprint_fn is None:
+            self._fingerprint_fn = jax.jit(param_fingerprint)
+        return check_desync(
+            float(self._fingerprint_fn(self.state.params)), inject=inject
+        )
+
+    def _rollback(self, epoch: int, epoch_time: float, reason: str) -> int | None:
+        """Restore the last good checkpoint (verified bytes, prev- fallback)
+        and return the epoch to replay from; None when no verified
+        checkpoint exists.  The epoch(s) being discarded move from the
+        goodput 'step' phase to 'rollback' — wasted compute must not count
+        as productive."""
+        if self.ckpt_writer is not None:
+            # drain in-flight saves so the newest last.ckpt is durable
+            # before it is read back; a failed save falls through to the
+            # prev- fallback rather than killing the recovery
+            with self.goodput.phase("ckpt"):
+                try:
+                    self.ckpt_writer.wait()
+                except Exception as e:
+                    self.logger.error(
+                        f"checkpoint writer error during rollback drain: {e}"
+                    )
+        hit = (
+            ckpt.valid_resume_bytes_in(self.version_dir)
+            if self.version_dir is not None
+            else None
+        )
+        if hit is None and self.is_main and self._rollback_source:
+            # fresh version dir with no save yet (explicit --resume): fall
+            # back to the read-only source checkpoint the run started from
+            source = Path(self._rollback_source)
+            if source.exists():
+                data = source.read_bytes()
+                ok, why = verify_checkpoint(source, data=data)
+                if ok:
+                    self.logger.warning(
+                        "health: no checkpoint in this run's version dir "
+                        f"yet; rolling back to the resume source {source}"
+                    )
+                    hit = (source, data)
+                else:
+                    self.logger.warning(
+                        f"health: resume source {source} no longer "
+                        f"verifies ({why}); cannot use it as rollback target"
+                    )
+        if jax.process_count() > 1:
+            # Only process 0 owns the version dir; agree on whether a
+            # target exists, then ship the restored host state to everyone
+            # (same idiom as test()'s best-checkpoint broadcast) — every
+            # collective entered by every process.
+            from jax.experimental import multihost_utils
+
+            found = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(hit is not None))
+            )
+            if not found:
+                return None
+            template = ckpt._state_dict(self.state)
+            if self.is_main:
+                path, data = hit
+                state0, next_epoch, best = ckpt.load_resume_state(
+                    path, self.state, raw_bytes=data
+                )
+                host = jax.tree_util.tree_map(
+                    np.asarray, ckpt._state_dict(state0)
+                )
+                meta = np.asarray([next_epoch, best], np.float64)
+            else:
+                host = jax.tree_util.tree_map(
+                    lambda l: np.zeros(l.shape, l.dtype), template
+                )
+                meta = np.zeros(2, np.float64)
+            synced = multihost_utils.broadcast_one_to_all(host)
+            meta = multihost_utils.broadcast_one_to_all(meta)
+            state = self.state.replace(
+                step=synced["step"],
+                params=synced["params"],
+                batch_stats=synced["batch_stats"],
+                opt_state=synced["opt_state"],
+            )
+            next_epoch, best = int(meta[0]), float(meta[1])
+        else:
+            if hit is None:
+                return None
+            path, data = hit
+            state, next_epoch, best = ckpt.load_resume_state(
+                path, self.state, raw_bytes=data
+            )
+        self.state = place_tree(state, self.state_sharding)
+        self.best_acc = best
+        self._resume_step_offset = 0  # a rollback replays whole epochs
+        wasted_epochs = max(1, epoch - next_epoch + 1)
+        wasted_s = self.goodput.transfer(
+            "step", "rollback", epoch_time * wasted_epochs
+        )
+        self.watchdog.record_rollback(
+            epoch, next_epoch,
+            wasted_steps=wasted_epochs * self.steps_per_epoch,
+            wasted_s=wasted_s, reason=reason,
+        )
+        self.logger.warning(
+            f"health: rolled back to end of epoch {next_epoch - 1} "
+            f"(replaying from epoch {next_epoch}; ~{wasted_s:.1f}s of step "
+            f"time wasted): {reason}"
+        )
+        if self.is_main:
+            self.watchdog.flush_events(self.version_dir)
+        return next_epoch
+
     # ------------------------------------------------------------- resilience
 
-    def _preempt_due(self, epoch: int) -> bool:
-        """Preemption pending at the end of ``epoch``?
+    def _preempt_due(
+        self, epoch: int, step: int | None = None, start_offset: int = 0
+    ) -> bool:
+        """Preemption pending at the end of ``epoch`` (``step=None``) or at
+        a chunk boundary ``step`` steps into it (host data mode polls
+        per chunk — the drain no longer waits for the epoch boundary)?
 
         SIGTERM delivery is per-host and need not be simultaneous (a
         partial spot reclaim can evict one VM of the slice), but the drain
         path runs collectives (symmetric fetch of partitioned state) — so
         under multi-host the per-host flags are OR-reduced and every
-        process acts on ANY host's preemption together.  The collective
-        only runs for resilient runs (handler or fault plan present):
-        non-resilient multi-host training keeps its schedule unchanged.
+        process acts on ANY host's preemption together (every process runs
+        the same chunk loop, so the per-chunk reduce stays symmetric).  The
+        collective only runs for resilient runs (handler or fault plan
+        present): non-resilient multi-host training keeps its schedule
+        unchanged.
         """
         if self.preempt_handler is None and self.fault_plan is None:
             return False
         due = bool(
-            (self.preempt_handler is not None and self.preempt_handler.triggered)
-            or (self.fault_plan is not None and self.fault_plan.preempt_due(epoch))
+            self.preempt_handler is not None and self.preempt_handler.triggered
         )
+        if self.fault_plan is not None:
+            if step is None:
+                # boundary check: in host mode, step=S events normally fire
+                # mid-epoch (below) and must not double-fire here; one that
+                # lands in the epoch's FINAL chunk (the mid-epoch poll stops
+                # one boundary early so a full epoch drains normally) — or
+                # past the epoch's step count — fires here instead of being
+                # silently dropped.  Device mode (the epoch is one device
+                # program) fires all step events at its boundary.
+                if self.data_mode == "device":
+                    due = due or self.fault_plan.preempt_due(epoch)
+                else:
+                    due = due or self.fault_plan.preempt_due(
+                        epoch, include_step_events=False
+                    ) or self.fault_plan.preempt_step_due(
+                        epoch,
+                        self.steps_per_epoch,
+                        self._epoch_step_base,
+                        cap=self.steps_per_epoch,
+                    )
+            else:
+                due = due or self.fault_plan.preempt_step_due(
+                    epoch, step, start_offset, cap=self.steps_per_epoch
+                )
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
@@ -755,6 +1057,49 @@ class Trainer:
             epoch=epoch, step=(epoch + 1) * self.steps_per_epoch
         )
 
+    def _preempt_exit_mid_epoch(self, epoch: int, steps_done: int):
+        """Mid-epoch drain (host data mode, chunk-boundary poll): save the
+        partial-epoch state with its progress recorded in the manifest
+        (``epoch_in_progress``/``epoch_steps_done``), so the relaunch
+        fast-forwards the loader and the per-step key fold past the steps
+        already trained — the trajectory continues exactly, and the grace
+        window shrinks from a whole epoch to one chunk."""
+        from ..resilience.preempt import EXIT_PREEMPTED
+
+        self.logger.warning(
+            f"preemption mid-epoch {epoch} "
+            f"({steps_done}/{self.steps_per_epoch} steps done): draining "
+            f"checkpoints, then exiting with code {EXIT_PREEMPTED} for the "
+            "supervisor"
+        )
+        state_ref = self.state
+        sync_fetch = jax.process_count() > 1 and needs_collective_fetch(state_ref)
+        if getattr(self.hparams, "save_last", True):
+            if sync_fetch:
+                with self.goodput.phase("ckpt"):
+                    state_ref = fetch_to_host(state_ref)
+            if self.is_main:
+                self.ckpt_writer.submit(
+                    lambda s=state_ref, e=epoch, b=self.best_acc, n=steps_done: (
+                        ckpt.save_resume_state(
+                            self.version_dir, s, e - 1, b,
+                            meta={
+                                **elastic.mesh_meta(self.mesh),
+                                "epoch_in_progress": e,
+                                "epoch_steps_done": n,
+                            },
+                        )
+                    ),
+                    key="last",
+                )
+        if self.ckpt_writer is not None:
+            with self.goodput.phase("ckpt"):
+                self.ckpt_writer.wait()
+        self._write_goodput(preempted=True)
+        raise Preempted(
+            epoch=epoch, step=epoch * self.steps_per_epoch + steps_done
+        )
+
     def _write_goodput(self, preempted: bool = False) -> None:
         """Append this attempt's goodput record to the run dir's
         ``goodput.jsonl`` (the supervisor aggregates records across restarts
@@ -773,6 +1118,12 @@ class Trainer:
             # the ckpt root also holds older runs' version dirs
             written_at=time.time(),
         )
+        if self.watchdog is not None:
+            record["health"] = self.watchdog.counters()
+        if self.ckpt_writer is not None:
+            # writer-thread utilization: visible when write-behind stops
+            # hiding the device→host fetch + serialize cost
+            record["ckpt_writer"] = self.ckpt_writer.stats()
         try:
             goodput_mod.append_goodput_record(
                 self.version_dir / "goodput.jsonl", record
@@ -787,30 +1138,62 @@ class Trainer:
                 )
         except OSError as e:  # accounting must never kill training
             self.logger.error(f"goodput record write failed: {e}")
+        if self.watchdog is not None:
+            self.watchdog.flush_events(self.version_dir)
+            out = getattr(self.hparams, "health_json", None)
+            if out:
+                try:
+                    write_health(out, self.watchdog.summary())
+                except OSError as e:
+                    self.logger.error(f"health report write failed: {e}")
+
+    def _step_fault_for(self, epoch: int):
+        """This epoch's injected ``(scale, start, stop)`` step-fault window
+        (consumed on fetch — a rollback replay runs clean), or None."""
+        if not self._step_faults:
+            return None
+        fault = self.fault_plan.step_fault(epoch, self.steps_per_epoch)
+        if fault[2] > fault[1]:
+            self.logger.warning(
+                f"injected step fault: loss/grads x{fault[0]} on steps "
+                f"[{fault[1]}, {fault[2]}) of epoch {epoch}"
+            )
+        return fault
 
     def _train_epoch_device(self, epoch: int) -> tuple[np.ndarray, float]:
         """Scanned epoch over the HBM-resident split: one dispatch, one fetch."""
-        self.state, stacked = self.epoch_runner(
+        self._epoch_step_base = 0
+        args = (
             self.state,
             self.trn_images,
             self.trn_labels,
             self.data_key,
             jnp.asarray(epoch),
         )
-        # ONE host fetch per epoch: loss/top1 and (MoE models only) the
-        # routing-health scalars come over the wire together — separate
-        # np.asarray calls would each pay a blocking round-trip (~95 ms on
-        # the tunneled bench host)
+        fault = self._step_fault_for(epoch)
+        if fault is not None:
+            self.state, stacked = self.epoch_runner(*args, fault)
+        else:
+            self.state, stacked = self.epoch_runner(*args)
+        # ONE host fetch per epoch: loss/top1, the numerics-guard flags and
+        # (MoE models only) the routing-health scalars come over the wire
+        # together — separate np.asarray calls would each pay a blocking
+        # round-trip (~95 ms on the tunneled bench host)
         fetched = jax.device_get(
             {
                 k: v
                 for k, v in stacked.items()
-                if k in ("loss", "top1_count") or k.startswith("moe_")
+                if k in ("loss", "top1_count", "skipped", "grad_norm")
+                or k.startswith("moe_")
             }
         )
         losses = np.asarray(fetched["loss"])
         top1 = float(np.sum(fetched["top1_count"]))
-        # stashed for fit()'s TB/log pass rather than widening the return
+        # stashed for fit()'s TB/log/health pass rather than widening the return
+        self._epoch_health = {
+            "skipped": np.asarray(fetched["skipped"]),
+            "grad_norm": np.asarray(fetched["grad_norm"]),
+        }
         self._moe_health = {
             k: float(np.mean(v)) for k, v in fetched.items()
             if k.startswith("moe_")
@@ -828,31 +1211,62 @@ class Trainer:
         prefetch thread assembles the next chunk.  Keys are folded from the
         global step index inside the chunk, so the trajectory is identical
         for any chunk size.
+
+        Chunk boundaries also poll for preemption (``_preempt_due`` with a
+        step index): a SIGTERM — or an injected ``preempt@epoch=K:step=S``
+        — drains at the NEXT boundary instead of the epoch's end, saving a
+        mid-epoch checkpoint whose manifest records the steps already done.
+        A mid-epoch resume fast-forwards the loader and starts the chunk
+        scan at that global step index, so the continued trajectory is
+        exactly the uninterrupted one.
         """
         self.train_loader.set_epoch(epoch)
         epoch_key = jax.random.fold_in(self.data_key, epoch)
         chunk = max(1, getattr(self.hparams, "host_chunk_steps", HOST_CHUNK_STEPS_DEFAULT))
+        offset = self._resume_step_offset if epoch == self.start_epoch else 0
+        self._resume_step_offset = 0  # one-shot: only the resumed epoch skips
+        self._epoch_step_base = offset
+        fault = self._step_fault_for(epoch)
         chunk_metrics = []
         it = iter(self.train_loader)
+        for _ in range(offset):  # mid-epoch resume: skip already-trained steps
+            next(it)
         bar = self._progress_bar(range(self.steps_per_epoch), desc=f"epoch {epoch}")
-        done = 0
+        if bar is not None and offset:
+            bar.update(offset)
+        done = offset
+        t_epoch = time.perf_counter()
         while done < self.steps_per_epoch:
             take = min(chunk, self.steps_per_epoch - done)
             xs, ys = zip(*(next(it) for _ in range(take)))
             batch = shard_batch(
                 {"x": np.stack(xs), "y": np.stack(ys)}, self.mesh, batch_axis=1
             )
-            self.state, metrics = self.chunk_runner(
-                self.state, batch["x"], batch["y"], epoch_key, jnp.asarray(done)
-            )
+            args = (self.state, batch["x"], batch["y"], epoch_key, jnp.asarray(done))
+            if fault is not None:
+                self.state, metrics = self.chunk_runner(*args, fault)
+            else:
+                self.state, metrics = self.chunk_runner(*args)
             chunk_metrics.append(metrics)  # (take,) device arrays; no sync
             done += take
             if bar is not None:
                 bar.update(take)
+            if done < self.steps_per_epoch and self._preempt_due(
+                epoch, step=done, start_offset=offset
+            ):
+                if bar is not None:
+                    bar.close()
+                # fit() never sees this partial epoch; book its step time
+                self.goodput.add("step", time.perf_counter() - t_epoch)
+                self._preempt_exit_mid_epoch(epoch, done)
         if bar is not None:
             bar.close()
         losses = np.concatenate([np.asarray(m["loss"]) for m in chunk_metrics])
         top1 = float(sum(float(np.asarray(m["top1_count"]).sum()) for m in chunk_metrics))
+        self._epoch_health = {
+            key: np.concatenate([np.asarray(m[key]) for m in chunk_metrics])
+            for key in ("skipped", "grad_norm")
+        }
         self._moe_health = {
             k: float(
                 np.concatenate([np.asarray(m[k]) for m in chunk_metrics]).mean()

@@ -43,7 +43,8 @@ def _fmt_secs(s: float) -> str:
 def format_table(reports: list[tuple[str, dict]]) -> str:
     header = (
         f"{'report':<28} {'wall':>9} {'product.':>9} {'ckpt':>9} "
-        f"{'stall':>9} {'restarts':>8} {'downtime':>9} {'goodput':>8} {'Δ':>8}"
+        f"{'stall':>9} {'rollback':>9} {'wr.busy':>9} {'restarts':>8} "
+        f"{'downtime':>9} {'goodput':>8} {'Δ':>8}"
     )
     lines = [header, "-" * len(header)]
     base = reports[0][1].get("goodput_frac", 0.0) if reports else 0.0
@@ -57,6 +58,8 @@ def format_table(reports: list[tuple[str, dict]]) -> str:
             f" {_fmt_secs(rep.get('productive_s', 0.0))}"
             f" {_fmt_secs(phases.get('ckpt', 0.0))}"
             f" {_fmt_secs(phases.get('stall', 0.0))}"
+            f" {_fmt_secs(phases.get('rollback', 0.0))}"
+            f" {_fmt_secs(rep.get('ckpt_writer_busy_s', 0.0))}"
             f" {rep.get('restarts', 0):>8}"
             f" {_fmt_secs(rep.get('restart_downtime_s', 0.0))}"
             f" {100 * goodput:7.1f}%"

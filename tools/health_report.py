@@ -1,0 +1,115 @@
+"""Pretty-print HEALTH.json reports (or run-dir health.jsonl event logs).
+
+Usage::
+
+    python tools/health_report.py HEALTH.json [OTHER.json ...]
+    python tools/health_report.py ckpts/version-0/health.jsonl
+
+One row per report: skipped (non-finite) steps, spike steps, rollbacks,
+desyncs, and the rollback waste (steps + seconds).  With more than one
+file, later rows show the rollback-count delta vs. the FIRST file (the
+baseline) — the question a robustness change has to answer is "did the run
+absorb the same faults with less waste".
+
+A ``health.jsonl`` (raw per-event records appended by the watchdog as the
+run trains) is aggregated on the fly, so an in-flight run can be inspected
+before its HEALTH.json exists; the last few events are echoed under the
+table for context.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+TAIL_EVENTS = 8
+
+
+def summarize_events(events: list[dict]) -> dict:
+    """Fold raw health.jsonl events into the HEALTH.json counter shape."""
+    out = {
+        "metric": "train_health",
+        "skipped_steps": 0,
+        "spike_steps": 0,
+        "rollbacks": 0,
+        "desyncs": 0,
+        "rollback_wasted_steps": 0,
+        "rollback_wasted_s": 0.0,
+        "events": events,
+    }
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "skip":
+            out["skipped_steps"] += int(ev.get("count", 1))
+        elif kind == "spike":
+            out["spike_steps"] += int(ev.get("count", 1))
+        elif kind == "desync":
+            out["desyncs"] += 1
+        elif kind == "rollback":
+            out["rollbacks"] += 1
+            out["rollback_wasted_steps"] += int(ev.get("wasted_steps", 0))
+            out["rollback_wasted_s"] += float(ev.get("wasted_s", 0.0))
+    return out
+
+
+def load_report(path: str | Path) -> dict:
+    path = Path(path)
+    if path.suffix == ".jsonl" or path.name == "health.jsonl":
+        from distributed_training_comparison_tpu.health import load_health_events
+
+        return summarize_events(load_health_events(path))
+    return json.loads(path.read_bytes())
+
+
+def format_table(reports: list[tuple[str, dict]]) -> str:
+    header = (
+        f"{'report':<28} {'skips':>7} {'spikes':>7} {'rollbk':>7} "
+        f"{'desync':>7} {'waste.steps':>11} {'waste.s':>9} {'Δrollbk':>8}"
+    )
+    lines = [header, "-" * len(header)]
+    base = reports[0][1].get("rollbacks", 0) if reports else 0
+    for i, (name, rep) in enumerate(reports):
+        delta = "" if i == 0 else f"{rep.get('rollbacks', 0) - base:+8d}"
+        lines.append(
+            f"{name:<28}"
+            f" {rep.get('skipped_steps', 0):>7}"
+            f" {rep.get('spike_steps', 0):>7}"
+            f" {rep.get('rollbacks', 0):>7}"
+            f" {rep.get('desyncs', 0):>7}"
+            f" {rep.get('rollback_wasted_steps', 0):>11}"
+            f" {rep.get('rollback_wasted_s', 0.0):>8.1f}s"
+            f" {delta:>8}"
+        )
+    tail = []
+    for name, rep in reports:
+        events = rep.get("events") or []
+        for ev in events[-TAIL_EVENTS:]:
+            tail.append(f"  [{name}] {json.dumps(ev)}")
+    if tail:
+        lines.append("")
+        lines.append(f"last events (up to {TAIL_EVENTS} per report):")
+        lines.extend(tail)
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    if not argv or any(a in ("-h", "--help") for a in argv):
+        print(__doc__)
+        return 0 if argv else 2
+    reports = []
+    for arg in argv:
+        label = arg if len(arg) <= 28 else "…" + arg[-27:]
+        try:
+            reports.append((label, load_report(arg)))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {arg}: {e}", file=sys.stderr)
+            return 2
+    print(format_table(reports))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
