@@ -68,4 +68,43 @@ CompilerParams = getattr(
     getattr(_pltpu, "TPUCompilerParams", _missing_compiler_params),
 )
 
-__all__ = ["shard_map", "axis_size", "CompilerParams"]
+
+from contextlib import nullcontext as _nullcontext
+
+try:  # thread-scoped config State, context-manager-able on this jax
+    from jax._src.config import (
+        persistent_cache_min_compile_time_secs as _min_compile_secs,
+    )
+except ImportError:  # pragma: no cover - future jax moved/renamed it
+    _min_compile_secs = None
+
+
+def donated_cache_write_barred():
+    """Context under which freshly-compiled executables are NEVER written to
+    the persistent on-disk cache (the min-compile-time write threshold is
+    raised past any real compile; the threshold is read at write time, so a
+    thread-scoped override works — unlike ``enable_compilation_cache``,
+    whose read path latches globally on first use).
+
+    Exists because buffer-DONATED executables round-tripped through the
+    on-disk cache misbehave on this jax's CPU backend: a warm-cache process
+    re-running the donated scanned runners segfaults or silently corrupts
+    the carried train state (reproduced while developing
+    tests/test_overlap.py; cold-cache and cache-off runs are correct, as
+    are non-donated programs).  The donated hot-path runners therefore
+    compile under this context: their executables exist only in process
+    memory, so no process can ever deserialize one — donation's HBM saving
+    is kept, the cache keeps serving the expensive non-donated programs
+    (eval runners, serve buckets), and only the donated runners pay a
+    per-process compile.  If the config State ever moves in a future jax,
+    this degrades to a no-op — caching donated programs again — so revisit
+    the underlying bug before upgrading past it.
+    """
+    if _min_compile_secs is None:  # pragma: no cover - future jax
+        return _nullcontext()
+    return _min_compile_secs(1e18)
+
+
+__all__ = [
+    "shard_map", "axis_size", "CompilerParams", "donated_cache_write_barred",
+]

@@ -39,6 +39,8 @@ from typing import Sequence
 WORKERS_DEFAULT = 4
 # host data mode: loader steps scanned per device dispatch
 HOST_CHUNK_STEPS_DEFAULT = 32
+# staged device chunks in flight ahead of the running dispatch (HBM cap)
+DEVICE_PREFETCH_DEFAULT = 2
 
 
 def build_parser(backend: str = "single") -> argparse.ArgumentParser:
@@ -338,6 +340,26 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "identical for any value)",
     )
     parser.add_argument(
+        "--device-chunk-steps",
+        type=int,
+        default=0,
+        help="device data mode: steps per scanned dispatch (0 = whole "
+        "epoch, the monolithic default — behavior unchanged). Smaller "
+        "chunks give the health watchdog and the preemption poll "
+        "chunk-boundary granularity mid-epoch; the trajectory is "
+        "bit-identical for any value (the chunk recomputes the epoch "
+        "permutation and per-step keys the monolithic program derives)",
+    )
+    parser.add_argument(
+        "--device-prefetch",
+        type=int,
+        default=DEVICE_PREFETCH_DEFAULT,
+        help="host data mode: staged device chunks the background H2D "
+        "thread keeps in flight ahead of the running dispatch (bounds the "
+        "extra HBM at N chunk buffers; transfer hides behind compute). "
+        "0 = synchronous staging on the main thread (the pre-overlap path)",
+    )
+    parser.add_argument(
         "--profile-dir",
         type=str,
         default=None,
@@ -574,6 +596,14 @@ def load_config(
         )
     if args.restart_backoff < 0:
         parser.error(f"--restart-backoff must be >= 0, got {args.restart_backoff}")
+    if args.device_chunk_steps < 0:
+        parser.error(
+            f"--device-chunk-steps must be >= 0, got {args.device_chunk_steps}"
+        )
+    if args.device_prefetch < 0:
+        parser.error(
+            f"--device-prefetch must be >= 0, got {args.device_prefetch}"
+        )
     if args.fault_plan:
         # a malformed fault plan must die at the CLI, not at epoch 0 of a
         # run that already burned its startup/compile time

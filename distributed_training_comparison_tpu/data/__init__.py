@@ -34,8 +34,10 @@ from .augment import random_crop_flip, normalize_images
 from .sampler import train_val_split, shard_indices, epoch_permutation
 from .loader import (
     DeviceDataset,
+    DevicePrefetcher,
     HostLoader,
     PrefetchLoader,
+    chunked_batches,
     get_datasets,
     get_trn_val_loader,
     get_tst_loader,
@@ -54,8 +56,10 @@ __all__ = [
     "shard_indices",
     "epoch_permutation",
     "DeviceDataset",
+    "DevicePrefetcher",
     "HostLoader",
     "PrefetchLoader",
+    "chunked_batches",
     "get_datasets",
     "get_trn_val_loader",
     "get_tst_loader",

@@ -157,7 +157,14 @@ class PrefetchLoader:
     never waits on batch assembly).
 
     Yields exactly the wrapped loader's sequence — same order, same
-    determinism — and re-raises any producer exception at the consumer.
+    determinism.  A producer exception is re-raised at the consuming call
+    site (the ``next()`` that would have received the failed batch), and the
+    consumer never hangs on a dead producer: the queue read polls the
+    thread's liveness, so a producer that died without signaling (a crash
+    outside the except net, e.g. interpreter teardown) raises instead of
+    blocking forever.  ``close()`` — also run by the iterator's ``finally``
+    on abandon — signals the producer, drains the queue, and JOINS the
+    thread, so an abort never leaks a runner stuck on a full queue.
     """
 
     _DONE = object()
@@ -165,6 +172,9 @@ class PrefetchLoader:
     def __init__(self, loader, depth: int = 2) -> None:
         self.loader = loader
         self.depth = max(1, depth)
+        self._stop: threading.Event | None = None
+        self._thread: threading.Thread | None = None
+        self._queue: queue.Queue | None = None
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
@@ -172,7 +182,35 @@ class PrefetchLoader:
     def __len__(self) -> int:
         return len(self.loader)
 
+    def _shutdown(self, stop, q, thread) -> None:
+        """Signal, drain, and JOIN one producer generation."""
+        if stop is not None:
+            stop.set()
+        if q is not None:
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        if thread is not None:
+            thread.join(timeout=10.0)
+            if thread.is_alive():  # pragma: no cover - diagnostic path
+                raise RuntimeError(
+                    "PrefetchLoader producer thread failed to stop within "
+                    "10s of close(); a batch source is blocked inside "
+                    f"{self.loader!r}"
+                )
+
+    def close(self) -> None:
+        """Stop the current epoch's producer (if any): signal, drain, join.
+        Idempotent; called by the iterator's cleanup and usable directly by
+        an aborting consumer."""
+        stop, thread, q = self._stop, self._thread, self._queue
+        self._stop = self._thread = self._queue = None
+        self._shutdown(stop, q, thread)
+
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        self.close()  # a fresh epoch supersedes any abandoned producer
         q: queue.Queue = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
 
@@ -195,11 +233,22 @@ class PrefetchLoader:
             except BaseException as e:  # surface producer errors, don't hang
                 _put(e)
 
-        thread = threading.Thread(target=produce, daemon=True)
+        thread = threading.Thread(
+            target=produce, name="dtc-prefetch", daemon=True
+        )
+        self._stop, self._thread, self._queue = stop, thread, q
         thread.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=1.0)
+                except queue.Empty:
+                    if not thread.is_alive():
+                        raise RuntimeError(
+                            "PrefetchLoader producer thread died without "
+                            "signaling completion or an exception"
+                        ) from None
+                    continue
                 if item is self._DONE:
                     break
                 if isinstance(item, BaseException):
@@ -207,14 +256,150 @@ class PrefetchLoader:
                 yield item
         finally:
             # consumer may abandon mid-epoch (steps_per_epoch break, error):
-            # signal the producer and drain so it never blocks forever
-            stop.set()
+            # signal the producer, drain, and join so it never blocks
+            # forever.  Tear down THIS generation's locals — a stale
+            # abandoned iterator must never kill a newer epoch's producer.
+            if self._thread is thread:
+                self._stop = self._thread = self._queue = None
+            self._shutdown(stop, q, thread)
+
+
+def chunked_batches(
+    batches: Iterator[tuple[np.ndarray, np.ndarray]],
+    total_steps: int,
+    chunk_steps: int,
+    start: int = 0,
+) -> Iterator[tuple[int, int, dict[str, np.ndarray]]]:
+    """Stack a batch iterator into ``(start, take, {"x", "y"})`` chunks of at
+    most ``chunk_steps`` steps, covering steps ``[start, total_steps)`` — the
+    host half of the chunked streaming path, shared by the synchronous
+    fallback and the ``DevicePrefetcher`` producer so the two can never
+    disagree on chunk boundaries."""
+    done = start
+    while done < total_steps:
+        take = min(chunk_steps, total_steps - done)
+        xs, ys = [], []
+        for _ in range(take):
             try:
-                while True:
-                    q.get_nowait()
+                x, y = next(batches)
+            except StopIteration:  # source ran dry: yield the partial chunk
+                break
+            xs.append(x)
+            ys.append(y)
+        if not xs:
+            return
+        yield done, len(xs), {"x": np.stack(xs), "y": np.stack(ys)}
+        done += len(xs)
+        if len(xs) < take:
+            return
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device chunk staging for the streaming train path.
+
+    A producer thread pulls the next ``chunk_steps`` batches from the (epoch's)
+    batch iterator, stacks them ``(K, B, ...)``, and immediately issues the
+    asynchronous ``jax.device_put`` via ``place`` (the trainer passes
+    ``shard_batch`` bound to the mesh + chunk sharding) — so the H2D copy of
+    chunk *i+1* rides the wire while chunk *i*'s scanned dispatch is still
+    executing on device.  The chip never waits on batch assembly OR transfer;
+    the main thread's only data-path work is a queue pop.
+
+    ``depth`` bounds the staged chunks in flight (producer blocks when the
+    queue is full), capping the extra HBM at ``depth`` chunk buffers — double
+    buffering is ``depth=1``; the default 2 absorbs one chunk of jitter.
+
+    Yields ``(start, take, device_batch)``.  A producer exception (loader
+    failure, a ``device_put`` OOM) is re-raised at the consuming ``next()``;
+    ``close()`` — idempotent, also the context-manager exit — signals the
+    producer, drains staged chunks, and joins the thread, so an aborting
+    consumer (preemption drain, error unwind) never leaks it.
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        batches: Iterator[tuple[np.ndarray, np.ndarray]],
+        total_steps: int,
+        chunk_steps: int,
+        place,
+        *,
+        start: int = 0,
+        depth: int = 2,
+    ) -> None:
+        self.depth = max(1, depth)
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._chunks = chunked_batches(batches, total_steps, chunk_steps, start)
+        self._place = place
+        self._thread = threading.Thread(
+            target=self._produce, name="dtc-device-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for begin, take, host_batch in self._chunks:
+                staged = self._place(host_batch)  # async H2D, returns at once
+                if not self._put((begin, take, staged)):
+                    return
+            self._put(self._DONE)
+        except BaseException as e:  # surfaced at the consumer's next()
+            self._put(e)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> tuple[int, int, dict]:
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
             except queue.Empty:
-                pass
-            thread.join(timeout=5.0)
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        "DevicePrefetcher producer thread died without "
+                        "signaling completion or an exception"
+                    ) from None
+                continue
+            if item is self._DONE:
+                self._q.put(item)  # keep the sentinel for a re-entrant next()
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self.close()
+                raise item
+            return item
+
+    def close(self) -> None:
+        """Stop the producer and join it: signal, drain staged chunks (their
+        device buffers free with the references), join."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
+        if self._thread.is_alive():  # pragma: no cover - diagnostic path
+            raise RuntimeError(
+                "DevicePrefetcher producer thread failed to stop within 10s "
+                "of close(); the batch source or device_put is blocked"
+            )
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def get_trn_val_loader(

@@ -27,6 +27,7 @@ from .ckpt_io import (
     atomic_write_bytes,
     manifest_path,
     previous_path,
+    read_and_hash,
     read_manifest,
     rotate_previous,
     verify_checkpoint,
@@ -42,6 +43,7 @@ __all__ = [
     "atomic_write_bytes",
     "manifest_path",
     "previous_path",
+    "read_and_hash",
     "read_manifest",
     "rotate_previous",
     "verify_checkpoint",

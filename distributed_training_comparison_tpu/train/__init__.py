@@ -16,7 +16,14 @@ compiled program on different mesh shapes.
 
 from .optim import configure_optimizers, step_lr_schedule
 from .state import TrainState, create_train_state
-from .step import make_train_step, make_eval_step, make_eval_runner, make_epoch_runner, make_chunk_runner
+from .step import (
+    make_train_step,
+    make_eval_step,
+    make_eval_runner,
+    make_epoch_runner,
+    make_chunk_runner,
+    make_device_chunk_runner,
+)
 from .async_ckpt import AsyncCheckpointer
 from .checkpoint import (
     agreed_version_dir,
@@ -38,6 +45,7 @@ __all__ = [
     "create_train_state",
     "make_train_step",
     "make_chunk_runner",
+    "make_device_chunk_runner",
     "make_eval_step",
     "make_eval_runner",
     "make_epoch_runner",

@@ -10,9 +10,10 @@ on-device state and continues; the transfer overlaps the next epoch's
 compute.
 
 Correctness notes:
-- the epoch runner must NOT donate its input state buffers (the worker may
-  still be fetching them); ``make_epoch_runner`` therefore keeps donation
-  off, trading one extra state copy of HBM for full overlap;
+- the scanned runners DONATE their input state buffers (the next dispatch
+  reuses them), so the Trainer hands this writer a device-side snapshot —
+  an HBM→HBM copy taken only on epochs that actually save — never a live
+  reference the next dispatch would invalidate mid-fetch;
 - ``wait()`` drains the queue — called before reading a checkpoint back
   (test phase, end of fit) and on ``close()``;
 - writes for the same target are serialized by the single worker, so

@@ -29,6 +29,7 @@ from ..parallel.sharding import fetch_to_host
 from ..resilience.ckpt_io import (
     atomic_write_bytes,
     previous_path,
+    read_and_hash,
     read_manifest,
     rotate_previous,
     verify_checkpoint,
@@ -203,8 +204,10 @@ def valid_resume_bytes_in(version_dir: str | Path) -> tuple[Path, bytes] | None:
     for candidate in (newest, previous_path(newest)):
         if not candidate.exists():
             continue
-        data = candidate.read_bytes()
-        ok, reason = verify_checkpoint(candidate, data=data)
+        # one pipelined pass: the SHA-256 of chunk i is computed while
+        # chunk i+1 is read — verify costs ~nothing over the restore read
+        data, digest = read_and_hash(candidate)
+        ok, reason = verify_checkpoint(candidate, data=data, digest=digest)
         if ok:
             if candidate != newest:
                 _log.warning(

@@ -28,6 +28,7 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import warnings
 from functools import partial
 from typing import Any, Callable
 
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
+from .._compat import donated_cache_write_barred
 from ..data.augment import normalize_images, random_crop_flip
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD
 from ..data.sampler import epoch_permutation
@@ -44,6 +46,37 @@ from ..parallel.sharding import batch_sharding, replicated_sharding
 from .state import TrainState
 
 Metrics = dict[str, jnp.ndarray]
+
+def _donated_jit(fun, *, donate_argnums, **jit_kw):
+    """``jax.jit`` with buffer donation whose executables are never WRITTEN
+    to the persistent compile cache: donated executables deserialized from
+    the on-disk cache misbehave on this jax's CPU backend (segfaults /
+    silently corrupted carries — see ``_compat.donated_cache_write_barred``).
+    Barring the write means no process can ever load one.  The context
+    wraps every call (compilation happens at the first call per shape);
+    steady-state calls pay only a thread-local config flip."""
+    jitted = jax.jit(fun, donate_argnums=donate_argnums, **jit_kw)
+
+    def call(*args):
+        # An input uint8 chunk can rarely alias any float output, so a
+        # donated image buffer that XLA finds no aliasing slot for triggers
+        # the unusable-donation advisory — the donation still releases the
+        # buffer at dispatch (the point: the chunk is consumed, its HBM must
+        # not outlive the call), so the warning is noise for these runners
+        # specifically; the scoped filter keeps it live for every other
+        # donated program in the process (e.g. serving's predict buffers).
+        # catch_warnings mutates process-global filter state for the span
+        # of the dispatch — acceptable here because nothing registers
+        # filters concurrently with a multi-second scan dispatch, and the
+        # global alternative would hide the advisory process-wide.
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            with donated_cache_write_barred():
+                return jitted(*args)
+
+    return call
 
 
 def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -265,9 +298,9 @@ def make_train_step(
     state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
 
-    # No buffer donation: the AsyncCheckpointer may still be fetching the
-    # previous state while the next step runs (see async_ckpt.py); the cost
-    # is one extra state copy of HBM.
+    # No buffer donation here: this per-step path serves benchmarks and
+    # tests that re-read their inputs after the call (the scanned runners
+    # donate — they own the train loop's hot path; see make_epoch_runner).
     return jax.jit(
         core,
         in_shardings=(state_sh, data_shard, data_shard, repl),
@@ -386,6 +419,7 @@ def make_chunk_runner(
     grad_accum: int = 1,
     fwd_bwd=None,
     fault_injection: bool = False,
+    donate: bool = True,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """K loader steps as ONE compiled ``lax.scan`` dispatch (host streaming).
 
@@ -400,6 +434,14 @@ def make_chunk_runner(
     bit-identical for ANY chunk size (chunk=1 reproduces the plain per-step
     path exactly).  One executable per distinct K (at most two per run: the
     full chunk and the remainder).
+
+    ``donate=True`` (default) donates the input state AND the consumed
+    image/label chunk: the state output aliases the state input (no
+    per-dispatch state copy in HBM — the trainer device-copies a snapshot
+    before handing the state to the async checkpoint writer), and the
+    single-use chunk buffers are released at dispatch instead of outliving
+    the call.  Callers that re-read an input after the call (none in the
+    train loop) must pass ``donate=False``.
 
     ``fault_injection=True`` appends a traced ``(scale, start, stop)``
     step-fault argument (indices are GLOBAL within the epoch, matching the
@@ -433,7 +475,96 @@ def make_chunk_runner(
             _run(state, images, labels, epoch_key, start, None)
         )
         in_sh = (state_sh, chunk_shard, chunk_shard, repl, repl)
+    if donate:
+        return _donated_jit(
+            run,
+            donate_argnums=(0, 1, 2),
+            in_shardings=in_sh,
+            out_shardings=(state_sh, repl),
+        )
     return jax.jit(run, in_shardings=in_sh, out_shardings=(state_sh, repl))
+
+
+def make_device_chunk_runner(
+    mesh: Mesh,
+    batch_size: int,
+    chunk_steps: int,
+    *,
+    precision: str = "fp32",
+    augment: bool = True,
+    mean=CIFAR100_MEAN,
+    std=CIFAR100_STD,
+    state_sharding=None,
+    grad_accum: int = 1,
+    fwd_bwd=None,
+    fault_injection: bool = False,
+    donate: bool = True,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """``chunk_steps`` steps of a device-resident epoch as ONE scanned
+    dispatch — the chunked form of ``make_epoch_runner``.
+
+    Bit-identity contract (the same one the host chunk runner documents):
+    the permutation and the per-step keys are recomputed exactly as the
+    monolithic epoch runner derives them — ``epoch_permutation(key, epoch,
+    n)`` and ``split(fold_in(fold_in(key, epoch), 1), steps)`` — and the
+    chunk dynamic-slices rows ``[start, start + K)`` out of both, so the
+    loss/param trajectory is bit-identical to the monolithic program for ANY
+    chunk size.  What chunking buys is a host touch point every K steps: the
+    health watchdog and the preemption poll gain chunk-boundary granularity
+    in device data mode, where the epoch used to be one uninterruptible
+    program.  The permutation recompute per chunk is O(n log n) device work
+    — noise next to K training steps for any practical K.
+
+    ``start`` is traced, so every full-size chunk shares one executable (at
+    most two per run: the full chunk and the remainder).  Callers must keep
+    ``start + chunk_steps <= steps`` — ``dynamic_slice`` clamps an
+    out-of-range start instead of failing, which would silently replay
+    batches.  ``donate=True`` donates only the state (the split arrays are
+    the epoch-persistent dataset).
+    """
+    data_shard = batch_sharding(mesh)
+    repl = replicated_sharding(mesh)
+    state_sh = state_sharding if state_sharding is not None else repl
+    accum_shard = batch_sharding(mesh, axis=1)
+    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
+
+    def _run(state: TrainState, images, labels, key: jax.Array, epoch, start, fault):
+        n = images.shape[0]
+        steps = n // batch_size
+        k = min(chunk_steps, steps)
+        epoch_key = jax.random.fold_in(key, epoch)
+        perm = epoch_permutation(key, epoch, n)[: steps * batch_size]
+        perm = perm.reshape(steps, batch_size)
+        step_keys = jax.random.split(jax.random.fold_in(epoch_key, 1), steps)
+        rows = jax.lax.dynamic_slice_in_dim(perm, start, k, axis=0)
+        keys = jax.lax.dynamic_slice_in_dim(step_keys, start, k, axis=0)
+
+        def body(state, inp):
+            idx, step_key, i = inp
+            bx = jax.lax.with_sharding_constraint(images[idx], data_shard)
+            by = jax.lax.with_sharding_constraint(labels[idx], data_shard)
+            if fault is None:
+                return core(state, bx, by, step_key)
+            return core(state, bx, by, step_key, _step_fault_scale(i, fault))
+
+        state, stacked = jax.lax.scan(
+            body, state, (rows, keys, start + jnp.arange(k))
+        )
+        return state, stacked
+
+    if fault_injection:
+        run = lambda state, images, labels, key, epoch, start, fault: (  # noqa: E731
+            _run(state, images, labels, key, epoch, start, fault)
+        )
+    else:
+        run = lambda state, images, labels, key, epoch, start: (  # noqa: E731
+            _run(state, images, labels, key, epoch, start, None)
+        )
+    if donate:
+        return _donated_jit(
+            run, donate_argnums=(0,), out_shardings=(state_sh, repl)
+        )
+    return jax.jit(run, out_shardings=(state_sh, repl))
 
 
 def make_epoch_runner(
@@ -448,6 +579,7 @@ def make_epoch_runner(
     grad_accum: int = 1,
     fwd_bwd=None,
     fault_injection: bool = False,
+    donate: bool = True,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -456,6 +588,14 @@ def make_epoch_runner(
     executable).  Per-epoch shuffling is a device-side permutation folded
     from (key, epoch); ``drop_last=True`` semantics match the reference's
     train loader (``src/single/dataset.py:97``).
+
+    ``donate=True`` (default) donates the input state: the output state
+    aliases it, eliminating the one extra state copy of HBM the runner used
+    to keep for the async checkpointer's benefit (the trainer now hands the
+    writer an explicit device-side snapshot instead — see ``Trainer.fit``).
+    The split arrays are NOT donated: they are the persistent dataset,
+    reused every epoch.  The eval runners likewise keep donation off — their
+    inputs (state, the padded val/test split) are all reused across calls.
 
     ``fault_injection=True`` appends a traced ``(scale, start, stop)``
     step-fault argument (``resilience/faults.py`` step faults); the default
@@ -496,5 +636,8 @@ def make_epoch_runner(
         run = lambda state, images, labels, key, epoch: (  # noqa: E731
             _run(state, images, labels, key, epoch, None)
         )
-    # No donation — see make_train_step note (async checkpoint overlap).
+    if donate:
+        return _donated_jit(
+            run, donate_argnums=(0,), out_shardings=(state_sh, repl)
+        )
     return jax.jit(run, out_shardings=(state_sh, repl))

@@ -12,11 +12,15 @@ multi-host after ``jax.distributed.initialize`` — is the only difference.
 
 TPU-native structure of ``fit``:
 
-- the whole epoch is ONE device program (``make_epoch_runner`` ``lax.scan``)
-  over the HBM-resident dataset; the host touches the device once per epoch
-  to fetch the stacked per-step losses — the reference's per-step
-  ``loss.item()`` sync (``src/single/trainer.py:147``) and per-step H2D
-  copies disappear;
+- the epoch runs as chunked ``lax.scan`` dispatches over the HBM-resident
+  dataset (``make_device_chunk_runner``; ``--device-chunk-steps`` defaults
+  to the whole epoch — ONE device program, the original design); the host
+  fetches the stacked per-step losses once per epoch — the reference's
+  per-step ``loss.item()`` sync (``src/single/trainer.py:147``) and
+  per-step H2D copies disappear.  Runners donate the input state (no
+  per-dispatch state copy), and the streaming path stages chunks to the
+  device from a background thread (``DevicePrefetcher``) so H2D transfer
+  hides behind compute;
 - the reference's every-``eval_step``-global-steps log lines are
   reconstructed exactly from the stacked loss array after the fact;
 - validation/test use a padded fixed-shape batch + weight mask so every
@@ -35,8 +39,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..config import HOST_CHUNK_STEPS_DEFAULT, WORKERS_DEFAULT
-from ..data import HostLoader, PrefetchLoader, get_datasets
+from ..config import (
+    DEVICE_PREFETCH_DEFAULT,
+    HOST_CHUNK_STEPS_DEFAULT,
+    WORKERS_DEFAULT,
+)
+from ..data import (
+    DevicePrefetcher,
+    HostLoader,
+    PrefetchLoader,
+    chunked_batches,
+    get_datasets,
+)
 from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET_STD
 from ..health import HealthConfig, Watchdog, check_desync, param_fingerprint, write_health
 from ..models import get_model
@@ -54,17 +68,18 @@ from ..resilience import (
     GoodputMeter,
     Preempted,
     PreemptionHandler,
+    read_and_hash,
     read_manifest,
     verify_checkpoint,
 )
 from ..resilience import elastic, goodput as goodput_mod
-from ..utils import AverageMeter, fix_seed, setup_logger
+from ..utils import AverageMeter, StepTimeMeter, fix_seed, setup_logger
 from ..utils.tensorboard import SummaryWriter
 from . import checkpoint as ckpt
 from .async_ckpt import AsyncCheckpointer
 from .optim import configure_optimizers
 from .state import create_train_state
-from .step import make_chunk_runner, make_epoch_runner, make_eval_runner
+from .step import make_chunk_runner, make_device_chunk_runner, make_eval_runner
 
 
 def _pad_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
@@ -326,19 +341,23 @@ class Trainer:
             if getattr(hparams, "legacy_test_stats", False)
             else (CIFAR100_MEAN, CIFAR100_STD)
         )
+        # Both data modes run CHUNKED scanned dispatches (device mode
+        # defaults to one whole-epoch chunk, preserving the monolithic
+        # behavior exactly); the runners DONATE the input state, so the
+        # output state reuses its buffers — no per-dispatch state copy in
+        # HBM.  The async checkpoint writer gets an explicit device-side
+        # snapshot instead of a live reference (see fit()).
+        dcs = getattr(hparams, "device_chunk_steps", 0) or 0
+        self._device_chunk = (
+            min(dcs, self.steps_per_epoch) if dcs > 0 else self.steps_per_epoch
+        )
+        self._device_runners: dict[int, callable] = {}
+        self._device_prefetch = getattr(
+            hparams, "device_prefetch", DEVICE_PREFETCH_DEFAULT
+        )
         if self.data_mode == "device":
-            self.epoch_runner = make_epoch_runner(
-                self.mesh,
-                hparams.batch_size,
-                precision=self.precision,
-                state_sharding=self.state_sharding,
-                grad_accum=self.grad_accum,
-                fwd_bwd=self.train_fwd_bwd,
-                fault_injection=self._step_faults,
-            )
             self.chunk_runner = None
         else:
-            self.epoch_runner = None
             self.chunk_runner = make_chunk_runner(
                 self.mesh,
                 precision=self.precision,
@@ -447,12 +466,15 @@ class Trainer:
         self._rollback_source = getattr(hparams, "resume", None)
         if getattr(hparams, "resume", None):
             if resume_bytes is None:
-                # explicit --resume: read once, verify that buffer (a torn
+                # explicit --resume: one read-and-hash pass (the checksum
+                # pipelines against large reads), verify that buffer (a torn
                 # file fails loudly at the CLI, not mid-restore), restore
                 # from it.  Auto-discovered paths arrive with their already-
                 # verified bytes from find_valid_resume_bytes.
-                resume_bytes = Path(hparams.resume).read_bytes()
-                ok, reason = verify_checkpoint(hparams.resume, data=resume_bytes)
+                resume_bytes, resume_digest = read_and_hash(hparams.resume)
+                ok, reason = verify_checkpoint(
+                    hparams.resume, data=resume_bytes, digest=resume_digest
+                )
                 if not ok:
                     raise ValueError(
                         f"refusing to resume from {hparams.resume}: {reason}"
@@ -477,20 +499,16 @@ class Trainer:
             if elastic_msg:
                 self.logger.info(elastic_msg)
             if manifest and manifest.get("epoch_in_progress") == self.start_epoch:
+                # both data modes fast-forward exactly: the loader order and
+                # the per-step keys (host mode) / the epoch permutation and
+                # key split (device mode) are functions of the global step
+                # index, not of where the attempt started
                 steps_done = int(manifest.get("epoch_steps_done", 0))
-                if self.data_mode == "host":
-                    self._resume_step_offset = steps_done
+                self._resume_step_offset = steps_done
+                if steps_done:
                     self.logger.info(
                         f"mid-epoch resume: epoch {self.start_epoch} "
                         f"fast-forwards past its first {steps_done} steps"
-                    )
-                elif steps_done:
-                    self.logger.warning(
-                        f"checkpoint was drained mid-epoch ({steps_done} "
-                        f"steps into epoch {self.start_epoch}) but device "
-                        "data mode runs whole epochs — those steps' updates "
-                        "are already in the restored state and the epoch "
-                        "will re-apply its full batch sequence"
                     )
         # --- training-health watchdog (health/): the compiled guards run
         # unconditionally (a skipped NaN update is strictly better than an
@@ -504,6 +522,12 @@ class Trainer:
         self._fingerprint_fn = None  # jitted lazily on first desync check
         self._epoch_health: dict = {}
         self._epoch_step_base = 0  # first global-within-epoch step trained
+        # step-time breakdown (h2d-wait / dispatch / compute): per-epoch
+        # meter + run totals for the goodput record; the snapshot program
+        # (device-side state copy for the async writer) compiles lazily
+        self._step_meter = StepTimeMeter()
+        self._overlap_totals = StepTimeMeter()
+        self._snapshot_fn = None
 
         # init/recovery cost: construction through restore + program builds
         # — the price every restart pays again, charged against goodput
@@ -538,6 +562,39 @@ class Trainer:
         except ImportError:
             return None
         return tqdm(iterable, desc=desc, leave=False)
+
+    def _snapshot_state(self, state):
+        """Device-side copy of ``state`` (same shardings, async dispatch).
+
+        The write-behind checkpointer fetches from this snapshot while the
+        next epoch's donated dispatch reuses the live state's buffers.  Cost:
+        one HBM→HBM state copy on epochs that actually save — versus the
+        pre-donation design's copy on EVERY dispatch.
+        """
+        if self._snapshot_fn is None:
+            self._snapshot_fn = jax.jit(
+                lambda s: jax.tree_util.tree_map(jnp.copy, s)
+            )
+        return self._snapshot_fn(state)
+
+    def _device_runner_for(self, take: int):
+        """The compiled device-mode chunk runner for a ``take``-step chunk
+        (cached; at most two live per run — the full chunk and the epoch's
+        remainder)."""
+        runner = self._device_runners.get(take)
+        if runner is None:
+            runner = make_device_chunk_runner(
+                self.mesh,
+                self.hparams.batch_size,
+                take,
+                precision=self.precision,
+                state_sharding=self.state_sharding,
+                grad_accum=self.grad_accum,
+                fwd_bwd=self.train_fwd_bwd,
+                fault_injection=self._step_faults,
+            )
+            self._device_runners[take] = runner
+        return runner
 
     # ------------------------------------------------------------------ train
 
@@ -628,6 +685,12 @@ class Trainer:
             self._log_tb("loss/epoch/val", val["val_loss"], epoch)
             self._log_tb("acc/epoch/val", val["val_acc"], epoch)
             self._log_tb("throughput/images_per_sec", imgs / epoch_time, epoch)
+            for phase_name, secs in self._step_meter.seconds.items():
+                # overlap health per epoch: h2d_wait climbing toward
+                # epoch_time means the input pipeline stopped hiding behind
+                # compute; near-zero means the chip never waited on data
+                self._log_tb(f"overlap/{phase_name}_s", secs, epoch)
+            self._overlap_totals.merge(self._step_meter)
             for k, v in getattr(self, "_moe_health", {}).items():
                 # moe_dropped_frac → moe/dropped_frac, moe_load_max →
                 # moe/load_max: a collapsed router (load_max → 1.0) or
@@ -664,6 +727,16 @@ class Trainer:
             throttled = not sync_fetch and (
                 time.monotonic() - self._last_resume_save < min_secs
             )
+            if jax.process_count() > 1 and not sync_fetch:
+                # the wall-clock throttle can diverge across hosts, and the
+                # writer snapshot below is a COMPUTATION every process must
+                # enter together — follow process 0's verdict (one tiny
+                # broadcast, in a mode whose epochs already run collectives)
+                from jax.experimental import multihost_utils
+
+                throttled = bool(
+                    multihost_utils.broadcast_one_to_all(np.asarray(throttled))
+                )
             want_last = getattr(hp, "save_last", True) and (
                 is_last_epoch or (due and not throttled)
             )
@@ -684,9 +757,19 @@ class Trainer:
                             params=fetch_to_host(state_ref.params),
                             batch_stats=fetch_to_host(state_ref.batch_stats),
                         )
+            elif want_best or want_last:
+                # The scanned runners DONATE the input state, so the next
+                # epoch's dispatch reuses these buffers — the async writer
+                # must get its own device-side snapshot (HBM→HBM copy,
+                # dispatched async; a computation, so under multi-host it
+                # runs on EVERY process), never a reference donation would
+                # invalidate mid-fetch.
+                with self.goodput.phase("ckpt"):
+                    state_ref = self._snapshot_state(state_ref)
             if self.is_main:
                 # write-behind: the worker thread fetches + serializes while
-                # the next epoch computes (state buffers are not donated)
+                # the next epoch computes (from the snapshot/host copy above
+                # — never the live state the donated dispatch will reuse)
                 if want_best:
                     self.ckpt_writer.submit(
                         lambda s=state_ref, e=epoch, b=self.best_acc: (
@@ -891,8 +974,8 @@ class Trainer:
             # back to the read-only source checkpoint the run started from
             source = Path(self._rollback_source)
             if source.exists():
-                data = source.read_bytes()
-                ok, why = verify_checkpoint(source, data=data)
+                data, digest = read_and_hash(source)
+                ok, why = verify_checkpoint(source, data=data, digest=digest)
                 if ok:
                     self.logger.warning(
                         "health: no checkpoint in this run's version dir "
@@ -974,8 +1057,9 @@ class Trainer:
         self, epoch: int, step: int | None = None, start_offset: int = 0
     ) -> bool:
         """Preemption pending at the end of ``epoch`` (``step=None``) or at
-        a chunk boundary ``step`` steps into it (host data mode polls
-        per chunk — the drain no longer waits for the epoch boundary)?
+        a chunk boundary ``step`` steps into it (both data modes poll per
+        chunk — the drain no longer waits for the epoch boundary; device
+        mode's grace window is one ``--device-chunk-steps`` chunk)?
 
         SIGTERM delivery is per-host and need not be simultaneous (a
         partial spot reclaim can evict one VM of the slice), but the drain
@@ -994,24 +1078,20 @@ class Trainer:
         )
         if self.fault_plan is not None:
             if step is None:
-                # boundary check: in host mode, step=S events normally fire
-                # mid-epoch (below) and must not double-fire here; one that
-                # lands in the epoch's FINAL chunk (the mid-epoch poll stops
-                # one boundary early so a full epoch drains normally) — or
-                # past the epoch's step count — fires here instead of being
-                # silently dropped.  Device mode (the epoch is one device
-                # program) fires all step events at its boundary.
-                if self.data_mode == "device":
-                    due = due or self.fault_plan.preempt_due(epoch)
-                else:
-                    due = due or self.fault_plan.preempt_due(
-                        epoch, include_step_events=False
-                    ) or self.fault_plan.preempt_step_due(
-                        epoch,
-                        self.steps_per_epoch,
-                        self._epoch_step_base,
-                        cap=self.steps_per_epoch,
-                    )
+                # boundary check: step=S events normally fire mid-epoch
+                # (below — BOTH data modes run chunked dispatches now) and
+                # must not double-fire here; one that lands in the epoch's
+                # FINAL chunk (the mid-epoch poll stops one boundary early
+                # so a full epoch drains normally) — or past the epoch's
+                # step count — fires here instead of being silently dropped.
+                due = due or self.fault_plan.preempt_due(
+                    epoch, include_step_events=False
+                ) or self.fault_plan.preempt_step_due(
+                    epoch,
+                    self.steps_per_epoch,
+                    self._epoch_step_base,
+                    cap=self.steps_per_epoch,
+                )
             else:
                 due = due or self.fault_plan.preempt_step_due(
                     epoch, step, start_offset, cap=self.steps_per_epoch
@@ -1120,6 +1200,11 @@ class Trainer:
         )
         if self.watchdog is not None:
             record["health"] = self.watchdog.counters()
+        if self._overlap_totals.chunks:
+            # where the main thread's time went inside the step phase:
+            # h2d_wait > 0 means the input pipeline failed to hide behind
+            # compute for that long (the overlap design's health gauge)
+            record["step_breakdown"] = self._overlap_totals.summary()
         if self.ckpt_writer is not None:
             # writer-thread utilization: visible when write-behind stops
             # hiding the device→host fetch + serialize cost
@@ -1161,41 +1246,101 @@ class Trainer:
         return fault
 
     def _train_epoch_device(self, epoch: int) -> tuple[np.ndarray, float]:
-        """Scanned epoch over the HBM-resident split: one dispatch, one fetch."""
-        self._epoch_step_base = 0
-        args = (
-            self.state,
-            self.trn_images,
-            self.trn_labels,
-            self.data_key,
-            jnp.asarray(epoch),
-        )
+        """Chunked scanned epoch over the HBM-resident split.
+
+        ``--device-chunk-steps`` steps per dispatch (default: the whole
+        epoch — exactly the old monolithic program).  Each chunk recomputes
+        the epoch permutation and the per-step key split the monolithic
+        runner derives and slices its ``[start, start+K)`` rows, so the
+        trajectory is bit-identical for ANY chunk size; what smaller chunks
+        buy is a host touch point mid-epoch — the preemption poll (and an
+        injected ``preempt@epoch=K:step=S``) drains at the next chunk
+        boundary with the steps-done count in the manifest, shrinking the
+        device-mode grace window from a whole epoch to one chunk, and a
+        mid-epoch resume fast-forwards ``start`` past the trained steps.
+        """
+        steps = self.steps_per_epoch
+        chunk = self._device_chunk
+        offset = self._resume_step_offset if epoch == self.start_epoch else 0
+        self._resume_step_offset = 0  # one-shot: only the resumed epoch skips
+        self._epoch_step_base = offset
         fault = self._step_fault_for(epoch)
-        if fault is not None:
-            self.state, stacked = self.epoch_runner(*args, fault)
-        else:
-            self.state, stacked = self.epoch_runner(*args)
-        # ONE host fetch per epoch: loss/top1, the numerics-guard flags and
-        # (MoE models only) the routing-health scalars come over the wire
-        # together — separate np.asarray calls would each pay a blocking
-        # round-trip (~95 ms on the tunneled bench host)
-        fetched = jax.device_get(
-            {
-                k: v
-                for k, v in stacked.items()
-                if k in ("loss", "top1_count", "skipped", "grad_norm")
-                or k.startswith("moe_")
-            }
-        )
-        losses = np.asarray(fetched["loss"])
-        top1 = float(np.sum(fetched["top1_count"]))
+        meter = self._step_meter
+        meter.reset()
+        epoch_arr = jnp.asarray(epoch)
+        chunk_metrics = []
+        bar = self._progress_bar(range(steps), desc=f"epoch {epoch}")
+        if bar is not None and offset:
+            bar.update(offset)
+        done = offset
+        t_epoch = time.perf_counter()
+        while done < steps:
+            take = min(chunk, steps - done)
+            runner = self._device_runner_for(take)
+            args = (
+                self.state,
+                self.trn_images,
+                self.trn_labels,
+                self.data_key,
+                epoch_arr,
+                jnp.asarray(done),
+            )
+            with meter.phase("dispatch"):
+                if fault is not None:
+                    self.state, metrics = runner(*args, fault)
+                else:
+                    self.state, metrics = runner(*args)
+            meter.note_chunk()
+            chunk_metrics.append(metrics)  # (take,) device arrays; no sync
+            done += take
+            if bar is not None:
+                bar.update(take)
+            if done < steps and self._preempt_due(
+                epoch, step=done, start_offset=offset
+            ):
+                if bar is not None:
+                    bar.close()
+                # fit() never sees this partial epoch; book its step time
+                self.goodput.add("step", time.perf_counter() - t_epoch)
+                self._preempt_exit_mid_epoch(epoch, done)
+        if bar is not None:
+            bar.close()
+        return self._collect_epoch_metrics(chunk_metrics)
+
+    def _collect_epoch_metrics(
+        self, chunk_metrics: list[dict]
+    ) -> tuple[np.ndarray, float]:
+        """ONE bulk host fetch for the epoch's stacked per-chunk metrics:
+        loss/top1, the numerics-guard flags and (MoE models only) the
+        routing-health scalars come over the wire together — separate
+        np.asarray calls would each pay a blocking round-trip (~95 ms on
+        the tunneled bench host).  This fetch is also where the main thread
+        finally blocks on the device, so it is the ``compute`` leg of the
+        step-time breakdown."""
+        keep = ("loss", "top1_count", "skipped", "grad_norm")
+        with self._step_meter.phase("compute"):
+            fetched = jax.device_get(
+                [
+                    {
+                        k: v
+                        for k, v in m.items()
+                        if k in keep or k.startswith("moe_")
+                    }
+                    for m in chunk_metrics
+                ]
+            )
+        losses = np.concatenate([np.asarray(m["loss"]) for m in fetched])
+        top1 = float(sum(np.asarray(m["top1_count"]).sum() for m in fetched))
         # stashed for fit()'s TB/log/health pass rather than widening the return
         self._epoch_health = {
-            "skipped": np.asarray(fetched["skipped"]),
-            "grad_norm": np.asarray(fetched["grad_norm"]),
+            key: np.concatenate([np.asarray(m[key]) for m in fetched])
+            for key in ("skipped", "grad_norm")
         }
         self._moe_health = {
-            k: float(np.mean(v)) for k, v in fetched.items()
+            k: float(
+                np.mean(np.concatenate([np.atleast_1d(m[k]) for m in fetched]))
+            )
+            for k in fetched[0]
             if k.startswith("moe_")
         }
         return losses, top1
@@ -1207,10 +1352,14 @@ class Trainer:
         DataLoader loop, ``src/ddp/trainer.py:143-174``).
 
         Per-step dispatch + H2D round-trips leave the chip idle between
-        tiny step programs; chunking amortizes that latency K× while the
-        prefetch thread assembles the next chunk.  Keys are folded from the
-        global step index inside the chunk, so the trajectory is identical
-        for any chunk size.
+        tiny step programs; chunking amortizes that latency K×, and the
+        ``DevicePrefetcher`` stacks the NEXT chunk and issues its
+        ``device_put`` on a background thread while the current chunk's
+        scan is still executing — H2D transfer fully hidden behind compute,
+        bounded by ``--device-prefetch`` staged chunks of HBM (0 = stage
+        synchronously on the main thread, the pre-overlap path).  Keys are
+        folded from the global step index inside the chunk, so the
+        trajectory is identical for any chunk size or prefetch depth.
 
         Chunk boundaries also poll for preemption (``_preempt_due`` with a
         step index): a SIGTERM — or an injected ``preempt@epoch=K:step=S``
@@ -1226,55 +1375,64 @@ class Trainer:
         offset = self._resume_step_offset if epoch == self.start_epoch else 0
         self._resume_step_offset = 0  # one-shot: only the resumed epoch skips
         self._epoch_step_base = offset
+        steps = self.steps_per_epoch
         fault = self._step_fault_for(epoch)
+        meter = self._step_meter
+        meter.reset()
         chunk_metrics = []
         it = iter(self.train_loader)
         for _ in range(offset):  # mid-epoch resume: skip already-trained steps
             next(it)
-        bar = self._progress_bar(range(self.steps_per_epoch), desc=f"epoch {epoch}")
+        place = lambda b: shard_batch(b, self.mesh, batch_axis=1)  # noqa: E731
+        if self._device_prefetch > 0:
+            chunks = DevicePrefetcher(
+                it, steps, chunk, place,
+                start=offset, depth=self._device_prefetch,
+            )
+        else:
+            chunks = (
+                (s, k, place(b))
+                for s, k, b in chunked_batches(it, steps, chunk, offset)
+            )
+        bar = self._progress_bar(range(steps), desc=f"epoch {epoch}")
         if bar is not None and offset:
             bar.update(offset)
         done = offset
         t_epoch = time.perf_counter()
-        while done < self.steps_per_epoch:
-            take = min(chunk, self.steps_per_epoch - done)
-            xs, ys = zip(*(next(it) for _ in range(take)))
-            batch = shard_batch(
-                {"x": np.stack(xs), "y": np.stack(ys)}, self.mesh, batch_axis=1
-            )
-            args = (self.state, batch["x"], batch["y"], epoch_key, jnp.asarray(done))
-            if fault is not None:
-                self.state, metrics = self.chunk_runner(*args, fault)
-            else:
-                self.state, metrics = self.chunk_runner(*args)
-            chunk_metrics.append(metrics)  # (take,) device arrays; no sync
-            done += take
-            if bar is not None:
-                bar.update(take)
-            if done < self.steps_per_epoch and self._preempt_due(
-                epoch, step=done, start_offset=offset
-            ):
+        try:
+            while done < steps:
+                with meter.phase("h2d_wait"):
+                    start, take, batch = next(chunks)
+                with meter.phase("dispatch"):
+                    args = (
+                        self.state, batch["x"], batch["y"],
+                        epoch_key, jnp.asarray(start),
+                    )
+                    if fault is not None:
+                        self.state, metrics = self.chunk_runner(*args, fault)
+                    else:
+                        self.state, metrics = self.chunk_runner(*args)
+                meter.note_chunk()
+                del batch  # donated at dispatch; drop the dead references
+                chunk_metrics.append(metrics)  # (take,) device arrays; no sync
+                done = start + take
                 if bar is not None:
-                    bar.close()
-                # fit() never sees this partial epoch; book its step time
-                self.goodput.add("step", time.perf_counter() - t_epoch)
-                self._preempt_exit_mid_epoch(epoch, done)
+                    bar.update(take)
+                if done < steps and self._preempt_due(
+                    epoch, step=done, start_offset=offset
+                ):
+                    if bar is not None:
+                        bar.close()
+                    # fit() never sees this partial epoch; book its step time
+                    self.goodput.add("step", time.perf_counter() - t_epoch)
+                    self._preempt_exit_mid_epoch(epoch, done)
+        finally:
+            # preemption drain / error unwind must join the staging thread
+            if isinstance(chunks, DevicePrefetcher):
+                chunks.close()
         if bar is not None:
             bar.close()
-        losses = np.concatenate([np.asarray(m["loss"]) for m in chunk_metrics])
-        top1 = float(sum(float(np.asarray(m["top1_count"]).sum()) for m in chunk_metrics))
-        self._epoch_health = {
-            key: np.concatenate([np.asarray(m[key]) for m in chunk_metrics])
-            for key in ("skipped", "grad_norm")
-        }
-        self._moe_health = {
-            k: float(
-                np.concatenate([np.asarray(m[k]) for m in chunk_metrics]).mean()
-            )
-            for k in chunk_metrics[0]
-            if k.startswith("moe_")
-        }
-        return losses, top1
+        return self._collect_epoch_metrics(chunk_metrics)
 
     # ------------------------------------------------------------------- eval
 
@@ -1360,6 +1518,10 @@ class Trainer:
         # crash path: fit() never reached its goodput write — record what
         # was accumulated so the attempt still shows up in the aggregate
         self._write_goodput()
+        if self.train_loader is not None and hasattr(self.train_loader, "close"):
+            # an aborted epoch may leave the batch-prefetch producer alive;
+            # join it deterministically rather than waiting on GC
+            self.train_loader.close()
         if self.preempt_handler is not None:
             self.preempt_handler.restore()
         if self.ckpt_writer is not None:

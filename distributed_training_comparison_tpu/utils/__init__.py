@@ -5,7 +5,7 @@ AverageMeter) rebuilt for JAX's explicit-PRNG model.
 """
 
 from .seed import fix_seed
-from .meters import AverageMeter
+from .meters import AverageMeter, StepTimeMeter
 from .metrics import accuracy, topk_correct
 from .logging import setup_logger
 from .compile_cache import enable_persistent_compilation_cache
@@ -13,6 +13,7 @@ from .compile_cache import enable_persistent_compilation_cache
 __all__ = [
     "fix_seed",
     "AverageMeter",
+    "StepTimeMeter",
     "accuracy",
     "topk_correct",
     "setup_logger",

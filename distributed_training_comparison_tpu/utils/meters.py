@@ -10,6 +10,9 @@ is a reference bottleneck we do not replicate, see
 
 from __future__ import annotations
 
+import time
+from contextlib import contextmanager
+
 
 class AverageMeter:
     """Tracks the latest value and a running (weighted) average."""
@@ -29,3 +32,60 @@ class AverageMeter:
         self.sum += val * n
         self.count += n
         self.avg = self.sum / self.count if self.count else 0.0
+
+
+class StepTimeMeter:
+    """Wall-clock breakdown of the chunked train loop's MAIN thread.
+
+    Three phases, chosen to expose what overlapped execution hides and what
+    it cannot:
+
+    - ``h2d_wait``  — blocked on the staged-chunk queue (``DevicePrefetcher``
+      pop): >0 means batch assembly + H2D transfer are NOT fully hidden
+      behind compute and the chip will idle for that long;
+    - ``dispatch``  — building + enqueueing the chunk program (async, so
+      this is host-side launch latency, not device compute);
+    - ``compute``   — blocked on device results (the bulk metrics fetch at
+      the epoch boundary, where all remaining device work drains).
+
+    Everything outside the three phases (preemption polls, tqdm, python loop
+    glue) is the residual against the epoch wall-clock the caller tracks.
+    An epoch whose time is dominated by ``compute`` is overlap working as
+    designed; time migrating into ``h2d_wait`` means the input pipeline is
+    the bottleneck (raise ``--workers`` / prefetch depth or shrink the
+    host-side batch work).
+    """
+
+    PHASES = ("h2d_wait", "dispatch", "compute")
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self.seconds = {p: 0.0 for p in self.PHASES}
+        self.chunks = 0
+
+    def add(self, phase: str, secs: float) -> None:
+        self.seconds[phase] += max(0.0, float(secs))
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def note_chunk(self) -> None:
+        self.chunks += 1
+
+    def merge(self, other: "StepTimeMeter") -> None:
+        """Fold another meter's totals in (per-epoch → per-run aggregation)."""
+        for p in self.PHASES:
+            self.seconds[p] += other.seconds[p]
+        self.chunks += other.chunks
+
+    def summary(self) -> dict:
+        out = {f"{p}_s": round(self.seconds[p], 4) for p in self.PHASES}
+        out["chunks"] = self.chunks
+        return out

@@ -105,7 +105,8 @@ def test_host_data_mode_end_to_end(tmp_path):
         ],
     )
     trainer = Trainer(hp, model=TinyNet(num_classes=100))
-    assert trainer.train_loader is not None and trainer.epoch_runner is None
+    assert trainer.train_loader is not None and trainer.chunk_runner is not None
+    assert not trainer._device_runners  # host mode builds no device-epoch program
     version = trainer.fit()
     results = trainer.test()
     trainer.close()

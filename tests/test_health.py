@@ -158,7 +158,11 @@ def test_guarded_epoch_skips_nonfinite_and_freezes_state(mesh, tiny_data):
     step counter all frozen) and report per-step skip flags that ride the
     stacked metrics fetch."""
     x, y = tiny_data
-    runner = make_epoch_runner(mesh, batch_size=64, fault_injection=True)
+    # donate=False: this test deliberately re-reads the INPUT state after
+    # the call to prove the guard froze it (the trainer's hot path donates)
+    runner = make_epoch_runner(
+        mesh, batch_size=64, fault_injection=True, donate=False
+    )
     state = _fresh_state(mesh)
     key = jax.random.key(3)
 
@@ -189,8 +193,11 @@ def test_fault_scale_injection_is_windowed_and_benign_at_one(mesh, tiny_data):
     x, y = tiny_data
     state = _fresh_state(mesh)
     key = jax.random.key(3)
-    plain = make_epoch_runner(mesh, batch_size=64)
-    faulted = make_epoch_runner(mesh, batch_size=64, fault_injection=True)
+    # donate=False: one state feeds three runner calls side by side
+    plain = make_epoch_runner(mesh, batch_size=64, donate=False)
+    faulted = make_epoch_runner(
+        mesh, batch_size=64, fault_injection=True, donate=False
+    )
     _, s_plain = plain(state, x, y, key, jnp.asarray(0))
     _, s_benign = faulted(state, x, y, key, jnp.asarray(0), (1.0, 0, 0))
     np.testing.assert_allclose(
@@ -507,6 +514,71 @@ def test_host_mode_mid_epoch_preempt_drains_and_resumes_exactly(tmp_path):
     clean_root = tmp_path / "clean"
     clean = Trainer(
         load_config("tpu", argv=HOST_ARGS + ["--ckpt-path", str(clean_root)]),
+        model=TinyNet(num_classes=100),
+    )
+    clean.fit()
+    clean.close()
+    _, resumed_params = _last_ckpt_params(root)
+    _, clean_params = _last_ckpt_params(clean_root)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        resumed_params, clean_params,
+    )
+
+
+DEVICE_ARGS = [
+    "--synthetic-data",
+    "--limit-examples", "512",   # 460 train examples -> 14 steps/epoch @32
+    "--batch-size", "32",
+    "--epoch", "2",
+    "--device-chunk-steps", "2",
+    "--save-last-min-secs", "0",
+    "--no-progress",
+    "--seed", "7",
+    "--eval-step", "1000",
+]
+
+
+def test_device_mode_mid_epoch_preempt_drains_and_resumes_exactly(tmp_path):
+    """ISSUE 4 acceptance: device data mode gains the same chunk-boundary
+    preemption drain host mode has — with ``--device-chunk-steps`` set, a
+    mid-epoch ``preempt@epoch=K:step=S`` drains at the next chunk boundary
+    (grace window = one chunk, not one epoch), the manifest records the
+    steps done, the relaunch fast-forwards the epoch permutation past them,
+    and final params match an uninterrupted run."""
+    root = tmp_path / "faulted"
+    argv = DEVICE_ARGS + [
+        "--ckpt-path", str(root), "--fault-plan", "preempt@epoch=0:step=4",
+    ]
+    trainer = Trainer(
+        load_config("tpu", argv=argv), model=TinyNet(num_classes=100)
+    )
+    with pytest.raises(Preempted) as exc:
+        trainer.fit()
+    trainer.close()
+    assert exc.value.epoch == 0 and exc.value.step == 4
+    manifest = read_manifest(root / "version-0" / "last.ckpt")
+    assert manifest["epoch"] == -1  # no epoch completed yet
+    assert manifest["epoch_in_progress"] == 0
+    assert manifest["epoch_steps_done"] == 4
+
+    # relaunch (fault plan intact, as a supervisor would): resumes INTO
+    # epoch 0 at step 4, does not re-fire the consumed preemption
+    resumed = Trainer(
+        load_config("tpu", argv=argv + ["--auto-resume"]),
+        model=TinyNet(num_classes=100),
+    )
+    assert resumed.start_epoch == 0
+    assert resumed._resume_step_offset == 4
+    resumed.fit()
+    resumed.close()
+    assert read_manifest(root / "version-0" / "last.ckpt")["epoch"] == 1
+
+    clean_root = tmp_path / "clean"
+    clean = Trainer(
+        load_config("tpu", argv=DEVICE_ARGS + ["--ckpt-path", str(clean_root)]),
         model=TinyNet(num_classes=100),
     )
     clean.fit()

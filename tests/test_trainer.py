@@ -145,6 +145,45 @@ def test_host_mode_chunk_invariance(tmp_path):
     np.testing.assert_array_equal(l1, l4)
 
 
+def test_device_mode_chunk_invariance(tmp_path):
+    """The chunked device path must produce a bit-identical loss trajectory
+    and state for any --device-chunk-steps (the chunk recomputes the epoch
+    permutation + key split the monolithic program derives — same contract
+    the host chunk runner documents), including a remainder-sized chunk."""
+    losses = {}
+    for chunk in (0, 2, 3):  # 0 = whole epoch; 3 leaves a remainder of 1
+        hp = _hparams(
+            tmp_path / f"c{chunk}",
+            extra=["--device-chunk-steps", str(chunk)],
+        )
+        t = Trainer(hp, model=TinyNet(num_classes=100))
+        ls, top1 = t._train_epoch_device(0)
+        losses[chunk] = (ls, top1, int(np.asarray(t.state.step)))
+        t.close()
+    l0, t0, s0 = losses[0]
+    for chunk in (2, 3):
+        lc, tc, sc = losses[chunk]
+        assert s0 == sc == len(l0) == len(lc)
+        assert t0 == tc
+        np.testing.assert_array_equal(l0, lc)
+
+
+def test_goodput_record_carries_step_breakdown(run_dir):
+    """The h2d-wait / dispatch / compute breakdown must ride the attempt's
+    goodput record (how overlap health reaches GOODPUT.json)."""
+    import json
+
+    tmp_path, version, _, _ = run_dir
+    record = json.loads(
+        (tmp_path / f"version-{version}" / "goodput.jsonl")
+        .read_text().splitlines()[0]
+    )
+    breakdown = record["step_breakdown"]
+    assert set(breakdown) == {"h2d_wait_s", "dispatch_s", "compute_s", "chunks"}
+    assert breakdown["chunks"] >= 2  # one per epoch at the default chunk
+    assert breakdown["dispatch_s"] >= 0.0
+
+
 def test_resume_continues(run_dir, tmp_path):
     src_tmp, version, _, trainer = run_dir
     last = src_tmp / f"version-{version}" / "last.ckpt"

@@ -1,0 +1,98 @@
+"""Pretty-print BENCH_OVERLAP.json captures and diff two of them.
+
+Usage::
+
+    python tools/overlap_report.py BENCH_OVERLAP.json [OTHER.json ...]
+
+One row per leg: images/sec, wall seconds, and — for the overlapped leg —
+the main-thread step-time breakdown (h2d-wait / dispatch / compute).  The
+headline ratios (overlap vs the blocking and async host paths, chunked vs
+monolithic device mode) print under the table.  With more than one file,
+each later capture also shows its per-leg throughput delta vs the FIRST
+(the baseline) — the question an overlap change has to answer is "did the
+streaming path get faster and did chunking stay free", and diffing raw
+JSON by eye does not answer it.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+RATIOS = (
+    ("overlap_vs_blocking", "host overlapped / host blocking"),
+    ("overlap_vs_async", "host overlapped / host async"),
+    ("device_chunked_vs_monolithic", "device chunked / monolithic"),
+    ("device_chunked_small_vs_monolithic", "device chunked-small / monolithic"),
+)
+
+
+def load_report(path: str | Path) -> dict:
+    return json.loads(Path(path).read_bytes())
+
+
+def format_report(reports: list[tuple[str, dict]]) -> str:
+    lines = []
+    base_legs = reports[0][1].get("legs", {}) if reports else {}
+    for i, (name, rep) in enumerate(reports):
+        lines.append(
+            f"{name}  [{rep.get('platform', '?')}/"
+            f"{rep.get('device_kind', '?')}  model={rep.get('model', '?')}"
+            f"  batch={rep.get('batch', '?')}  chunk={rep.get('chunk_steps', '?')}]"
+        )
+        header = f"  {'leg':<24} {'img/s':>10} {'wall':>9} {'Δ vs base':>10}"
+        lines.append(header)
+        lines.append("  " + "-" * (len(header) - 2))
+        for leg, rec in rep.get("legs", {}).items():
+            if "error" in rec:
+                lines.append(f"  {leg:<24} {'ERROR':>10}  {rec['error'][:48]}")
+                continue
+            ips = rec.get("images_per_sec", 0.0)
+            delta = ""
+            if i > 0:
+                base = base_legs.get(leg, {}).get("images_per_sec")
+                if base:
+                    delta = f"{100 * (ips / base - 1):+8.1f}%"
+            lines.append(
+                f"  {leg:<24} {ips:>10.1f} {rec.get('wall_s', 0.0):>8.2f}s"
+                f" {delta:>10}"
+            )
+            breakdown = rec.get("step_breakdown")
+            if breakdown:
+                lines.append(
+                    "  {:<24} h2d_wait {:.3f}s  dispatch {:.3f}s  "
+                    "compute {:.3f}s  ({} chunks)".format(
+                        "  └ breakdown",
+                        breakdown.get("h2d_wait_s", 0.0),
+                        breakdown.get("dispatch_s", 0.0),
+                        breakdown.get("compute_s", 0.0),
+                        breakdown.get("chunks", 0),
+                    )
+                )
+        for key, label in RATIOS:
+            val = rep.get(key)
+            if val is not None:
+                lines.append(f"  {label:<42} {val:>6.3f}x")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    if not argv or any(a in ("-h", "--help") for a in argv):
+        print(__doc__)
+        return 0 if argv else 2
+    reports = []
+    for arg in argv:
+        label = arg if len(arg) <= 40 else "…" + arg[-39:]
+        try:
+            reports.append((label, load_report(arg)))
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {arg}: {e}", file=sys.stderr)
+            return 2
+    print(format_report(reports))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
