@@ -709,6 +709,22 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
     return record
 
 
+def events_check_rc(ckpt_root: str) -> int:
+    """Self-validate a bench capture: ``tools/run_report.py --check`` over
+    every ``events*.jsonl`` the run left behind, returncode recorded in the
+    committed JSON (0 = every record parses against the versioned obs
+    schema) — nobody trusts the numbers of a capture that doesn't."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "run_report.py"),
+         ckpt_root, "--check"],
+    ).returncode
+
+
 def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     """The resilience leg: a real supervised training run through the fault
     gauntlet — injected preemption at epoch 1, supervisor relaunch with
@@ -754,16 +770,24 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
         "--fault-plan", "preempt@epoch=1",
     ]
 
+    from distributed_training_comparison_tpu import obs
+
+    run_id = obs.new_run_id()  # one identity across the gauntlet's attempts
+
     def env_for(attempt: int) -> dict:
         if not device_counts:
-            return dict(os.environ)
-        from distributed_training_comparison_tpu.resilience.elastic import (
-            forced_host_device_env,
-        )
+            env = dict(os.environ)
+        else:
+            from distributed_training_comparison_tpu.resilience.elastic import (
+                forced_host_device_env,
+            )
 
-        return forced_host_device_env(
-            device_counts.get(attempt, device_counts[max(device_counts)])
-        )
+            env = forced_host_device_env(
+                device_counts.get(attempt, device_counts[max(device_counts)])
+            )
+        env[obs.RUN_ID_ENV] = run_id
+        env[obs.ATTEMPT_ENV] = str(attempt)
+        return env
 
     def runner(c, env):
         proc = subprocess.run(list(c), env=env, capture_output=True, text=True)
@@ -788,9 +812,11 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     )
     record["supervisor"] = summary
     record["platform"] = platform
+    record["events_check_rc"] = events_check_rc(ckpt_root)
     write_goodput(out_path, record)
     print(json.dumps({
         "metric": record["metric"],
+        "events_check_rc": record["events_check_rc"],
         "goodput_frac": record["goodput_frac"],
         "productive_s": record["productive_s"],
         "total_wall_s": record["total_wall_s"],
@@ -879,6 +905,7 @@ def bench_health(
         **summary,
         "platform": platform,
         "fault_plan": hp.fault_plan,
+        "events_check_rc": events_check_rc(ckpt_root),
         "goodput": {
             "goodput_frac": goodput["goodput_frac"],
             "productive_s": goodput["productive_s"],

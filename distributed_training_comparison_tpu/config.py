@@ -558,6 +558,29 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "counts + events) to this path at the end of the run; per-event "
         "records always land in the run dir's health.jsonl",
     )
+    # observability (obs/ subsystem: run-event bus + span tracing + flight
+    # recorder; tools/run_report.py merges/validates the artifacts)
+    parser.add_argument(
+        "--obs",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="Run-event bus + span tracing: append every run event "
+        "(epochs, health verdicts, rollbacks, preemptions, writer gauges, "
+        "goodput) to the version dir's events.jsonl under one versioned "
+        "schema, and export the host-thread span timeline as a "
+        "Chrome-trace/Perfetto trace.json. --no-obs writes neither file "
+        "and keeps only the in-memory flight-recorder ring (which still "
+        "dumps crash_dump.json on abort — forensics survive the opt-out)",
+    )
+    parser.add_argument(
+        "--flight-recorder-size",
+        type=int,
+        default=256,
+        help="Bounded in-memory ring of the last N run events, dumped to "
+        "crash_dump.json on abort, watchdog budget exhaustion, or an "
+        "unhandled exception — the post-mortem that no longer depends on "
+        "scraping log files",
+    )
     parser.add_argument(
         "--legacy-test-stats",
         action="store_true",
@@ -596,6 +619,10 @@ def load_config(
         )
     if args.restart_backoff < 0:
         parser.error(f"--restart-backoff must be >= 0, got {args.restart_backoff}")
+    if args.flight_recorder_size < 1:
+        parser.error(
+            f"--flight-recorder-size must be >= 1, got {args.flight_recorder_size}"
+        )
     if args.device_chunk_steps < 0:
         parser.error(
             f"--device-chunk-steps must be >= 0, got {args.device_chunk_steps}"

@@ -23,6 +23,7 @@ from typing import Iterator
 
 import numpy as np
 
+from ..obs import span as _obs_span
 from .cifar100 import load_cifar100
 from .sampler import shard_indices, train_val_split
 from .synthetic import synthetic_dataset
@@ -226,7 +227,15 @@ class PrefetchLoader:
 
         def produce() -> None:
             try:
-                for item in self.loader:
+                it = iter(self.loader)
+                while True:
+                    # span the assembly only, not the bounded put: queue
+                    # backpressure is the consumer running ahead, not work
+                    with _obs_span("batch_assemble"):
+                        try:
+                            item = next(it)
+                        except StopIteration:
+                            break
                     if not _put(item):
                         return
                 _put(self._DONE)
@@ -349,8 +358,16 @@ class DevicePrefetcher:
 
     def _produce(self) -> None:
         try:
-            for begin, take, host_batch in self._chunks:
-                staged = self._place(host_batch)  # async H2D, returns at once
+            while True:
+                # one span per staged chunk: batch stacking + the async
+                # device_put issue — the queue put is excluded (blocking
+                # there is backpressure from a full prefetch window)
+                with _obs_span("h2d_stage"):
+                    try:
+                        begin, take, host_batch = next(self._chunks)
+                    except StopIteration:
+                        break
+                    staged = self._place(host_batch)  # async H2D
                 if not self._put((begin, take, staged)):
                     return
             self._put(self._DONE)

@@ -83,6 +83,17 @@ def run(backend: str, argv: Sequence[str] | None = None) -> dict:
                 # counted once; unlike the reference's
                 # rank-0-tests-its-own-shard quirk).
                 results.update(trainer.test())
+    except BaseException as e:
+        # flight recorder: an unhandled exception (or a Ctrl-C / SIGINT
+        # killing the run mid-epoch) dumps the final ring of run events to
+        # crash_dump.json before the process dies — the in-flight aborts
+        # (non-finite, budget exhaustion) already dumped with their own
+        # reason, and dump_crash never raises
+        trainer.bus.dump_crash(
+            f"unhandled {type(e).__name__} in run()", exc=e,
+            directory=trainer._obs_dir,
+        )
+        raise
     finally:
         trainer.close()
     if is_main_process():

@@ -21,6 +21,7 @@ must abort loudly, not loop.  Every event is appended to the run dir's
 from __future__ import annotations
 
 import json
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -76,9 +77,16 @@ def _max_run(flags: np.ndarray) -> int:
 class Watchdog:
     """Accumulates health events for one training attempt."""
 
-    def __init__(self, config: HealthConfig | None = None, logger=None) -> None:
+    def __init__(
+        self, config: HealthConfig | None = None, logger=None, bus=None
+    ) -> None:
         self.cfg = config or HealthConfig()
         self.logger = logger
+        # run-event bus (obs/): when set, every health event ALSO emits on
+        # the unified timeline, and the health.jsonl records carry the
+        # bus's run_id/attempt/process_index/t_wall stamp — back-compatibly
+        # (old records stay parseable; tools accept both shapes)
+        self.bus = bus
         self.detector = SpikeDetector(
             window=self.cfg.window,
             threshold_mads=self.cfg.spike_mads,
@@ -165,7 +173,14 @@ class Watchdog:
     # ------------------------------------------------------------ reporting
 
     def _event(self, kind: str, epoch: int, **extra) -> None:
-        self.events.append({"kind": kind, "epoch": int(epoch), **extra})
+        record = {"kind": kind, "epoch": int(epoch), **extra}
+        if self.bus is not None:
+            # stamp the legacy record so health.jsonl rows join the
+            # unified timeline on run_id/attempt, and mirror the event
+            # onto the bus itself
+            record.update(self.bus.stamp(), t_wall=time.time())
+            self.bus.emit(kind, epoch=epoch, **extra)
+        self.events.append(record)
         self._unflushed += 1
         if self.logger is not None and kind != "rollback":
             self.logger.warning(f"health: {kind} at epoch {epoch}: {extra}")

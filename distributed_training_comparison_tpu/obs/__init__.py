@@ -1,0 +1,89 @@
+"""Observability: the run-event bus, span tracing, and the flight recorder.
+
+Four generations of ad-hoc telemetry preceded this package — goodput
+records (PR 2), health events (PR 3), the step-time breakdown (PR 4), and
+the serve metrics — each with its own schema, file, and report tool, and
+none able to answer "what was every thread of this run doing at second T
+of attempt 3".  ``obs`` is the one layer they all now report through:
+
+- ``bus.py``   — the **run-event bus**: one append-only ``events.jsonl``
+  per attempt with a single versioned schema (run_id / attempt /
+  process_index / wall + monotonic timestamps / kind / payload), plus the
+  bounded in-memory ring the **flight recorder** dumps to
+  ``crash_dump.json`` on abort or unhandled exception;
+- ``spans.py`` — **host-side span tracing**: a nestable
+  ``span("epoch")`` context manager recording begin/end pairs on every
+  thread (trainer loop, ``DevicePrefetcher`` producer, the async
+  checkpoint writer), exported as Chrome-trace/Perfetto JSON so one file
+  shows compute, staging, and checkpointing overlapping in time.  During
+  a ``--profile-dir`` capture the same spans also emit
+  ``jax.profiler.TraceAnnotation``s, so the xplane's device timeline
+  carries the host span names.
+
+The process holds ONE current bus and ONE current span recorder
+(``configure`` installs them; ``emit``/``span`` reach them from any
+module without plumbing).  Before a Trainer binds the bus to its version
+dir, events accumulate in memory and flush on bind — nothing emitted
+during construction is lost.  The default, never-configured bus keeps
+only the ring: library embedders that never call ``configure`` pay one
+deque append per event and write no files.
+
+``tools/run_report.py`` merges ``events*.jsonl`` across attempts and
+hosts into one timeline + summary and validates captures (``--check``).
+"""
+
+from __future__ import annotations
+
+from .bus import (
+    ATTEMPT_ENV,
+    CRASH_DUMP_NAME,
+    EVENTS_NAME,
+    RUN_ID_ENV,
+    SCHEMA_VERSION,
+    EventBus,
+    configure,
+    crash_dump_filename,
+    current_bus,
+    emit,
+    events_filename,
+    load_events,
+    new_run_id,
+    reset,
+    validate_event,
+)
+from .spans import (
+    SpanRecorder,
+    chrome_trace,
+    current_recorder,
+    set_recorder,
+    span,
+    step_annotation,
+    trace_filename,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EVENTS_NAME",
+    "CRASH_DUMP_NAME",
+    "RUN_ID_ENV",
+    "ATTEMPT_ENV",
+    "EventBus",
+    "configure",
+    "crash_dump_filename",
+    "current_bus",
+    "emit",
+    "events_filename",
+    "load_events",
+    "new_run_id",
+    "reset",
+    "validate_event",
+    "SpanRecorder",
+    "chrome_trace",
+    "current_recorder",
+    "set_recorder",
+    "span",
+    "step_annotation",
+    "trace_filename",
+    "write_chrome_trace",
+]

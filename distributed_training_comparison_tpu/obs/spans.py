@@ -1,0 +1,232 @@
+"""Host-side span tracing: nestable begin/end pairs on every thread.
+
+``span("epoch")`` / ``span("h2d_stage")`` context managers record wall
+intervals per thread — the trainer loop, the ``DevicePrefetcher``
+producer, the ``AsyncCheckpointer`` writer — into one process-wide
+recorder.  Export is Chrome-trace JSON (``chrome_trace``): open it in
+Perfetto / ``chrome://tracing`` and the threads render as lanes, so
+compute, input staging, checkpointing, and rollback visibly overlap (or
+fail to).
+
+Spans nest strictly by construction: each is a context manager pushed and
+popped on a per-thread stack, so a thread's spans at depth d always lie
+inside its enclosing depth d-1 span — the invariant the export test pins.
+
+During a ``--profile-dir`` capture (``recorder.annotate = True``) every
+span also enters a ``jax.profiler.TraceAnnotation``, so the xplane's host
+timeline carries the same names and a device trace joins the host spans
+by step id (the trainer additionally wraps chunk dispatches in
+``StepTraceAnnotation``).  Outside a capture the cost of a span is two
+clock reads and one dict append.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager, nullcontext
+from pathlib import Path
+
+TRACE_NAME = "trace.json"
+MAX_SPANS_DEFAULT = 200_000
+
+
+def trace_filename(attempt: int = 0, process_index: int = 0) -> str:
+    """Per-attempt (and, off process 0, per-process) trace file name."""
+    if attempt == 0 and process_index == 0:
+        return TRACE_NAME
+    if process_index == 0:
+        return f"trace-a{attempt}.json"
+    return f"trace-a{attempt}-p{process_index}.json"
+
+
+class SpanRecorder:
+    """Collects closed spans for one process; thread-safe."""
+
+    def __init__(
+        self, process_index: int = 0, max_spans: int = MAX_SPANS_DEFAULT
+    ) -> None:
+        self.process_index = int(process_index)
+        self.max_spans = int(max_spans)
+        self.annotate = False  # emit jax TraceAnnotations alongside
+        self._lock = threading.Lock()
+        self._spans: list[dict] = []
+        self._dropped = 0
+        self._local = threading.local()
+
+    def _stack(self) -> list[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        stack = self._stack()
+        depth = len(stack)
+        stack.append(name)
+        thread = threading.current_thread()
+        ann = (
+            _trace_annotation(name) if self.annotate else nullcontext()
+        )
+        t0 = time.monotonic()
+        try:
+            with ann:
+                yield
+        finally:
+            t1 = time.monotonic()
+            stack.pop()
+            rec = {
+                "name": str(name),
+                "t0": t0,
+                "t1": t1,
+                "thread_id": thread.ident,
+                "thread_name": thread.name,
+                "depth": depth,
+            }
+            if attrs:
+                rec["args"] = attrs
+            with self._lock:
+                if len(self._spans) < self.max_spans:
+                    self._spans.append(rec)
+                else:
+                    self._dropped += 1
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+
+def _trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` if this jax exposes one."""
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except (ImportError, AttributeError):  # pragma: no cover - exotic jax
+        return nullcontext()
+
+
+def step_annotation(step: int | None = None):
+    """``jax.profiler.StepTraceAnnotation("train", step_num=...)`` — the
+    marker the profile tooling joins device time to step ids with.  The
+    trainer wraps each chunk dispatch of the profiled epoch in one, so the
+    xplane capture gains step boundaries (it had none before)."""
+    try:
+        import jax.profiler
+
+        kwargs = {} if step is None else {"step_num": int(step)}
+        return jax.profiler.StepTraceAnnotation("train", **kwargs)
+    except (ImportError, AttributeError):  # pragma: no cover - exotic jax
+        return nullcontext()
+
+
+# ---------------------------------------------------------- chrome export
+
+
+def chrome_trace(
+    spans: list[dict],
+    process_index: int = 0,
+    label: str | None = None,
+    dropped: int = 0,
+) -> dict:
+    """Spans → the Chrome Trace Event JSON object Perfetto loads.
+
+    Complete ("X") events carry begin+duration in one record, so the
+    strict nesting the recorder guarantees arrives intact; thread/process
+    metadata events name the lanes.
+    """
+    events: list[dict] = []
+    threads: dict[int, str] = {}
+    for s in spans:
+        tid = int(s.get("thread_id") or 0)
+        threads.setdefault(tid, str(s.get("thread_name") or f"thread-{tid}"))
+        ev = {
+            "ph": "X",
+            "name": s["name"],
+            "pid": process_index,
+            "tid": tid,
+            "ts": round(s["t0"] * 1e6, 3),   # microseconds, Chrome's unit
+            "dur": round(max(0.0, s["t1"] - s["t0"]) * 1e6, 3),
+        }
+        if s.get("args"):
+            ev["args"] = s["args"]
+        events.append(ev)
+    events.sort(key=lambda e: (e["tid"], e["ts"], -e["dur"]))
+    name = label or f"process {process_index}"
+    if dropped:
+        # a trace that hit the recorder cap is TRUNCATED, not quiet — name
+        # the lane so a Perfetto reader can't mistake the cutoff for the
+        # run going idle
+        name += f" [TRUNCATED: {dropped} spans dropped at cap]"
+    meta: list[dict] = [
+        {
+            "ph": "M", "name": "process_name", "pid": process_index,
+            "args": {"name": name},
+        }
+    ]
+    for tid, tname in sorted(threads.items()):
+        meta.append(
+            {
+                "ph": "M", "name": "thread_name", "pid": process_index,
+                "tid": tid, "args": {"name": tname},
+            }
+        )
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(
+    path: str | Path,
+    recorder: "SpanRecorder | list[dict]",
+    label: str | None = None,
+) -> Path | None:
+    """Export a recorder (or raw span list) to ``path``; never raises —
+    trace export is accounting."""
+    if isinstance(recorder, SpanRecorder):
+        spans, pidx, dropped = (
+            recorder.spans(), recorder.process_index, recorder.dropped,
+        )
+    else:
+        spans, pidx, dropped = list(recorder), 0, 0
+    path = Path(path)
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(chrome_trace(spans, pidx, label=label, dropped=dropped), f)
+    except OSError:
+        return None
+    return path
+
+
+# ---------------------------------------------------------- process-current
+
+_current: SpanRecorder | None = None
+_current_lock = threading.Lock()
+
+
+def set_recorder(recorder: SpanRecorder | None) -> SpanRecorder | None:
+    """Install ``recorder`` as process-current; returns the previous one."""
+    global _current
+    with _current_lock:
+        old, _current = _current, recorder
+    return old
+
+
+def current_recorder() -> SpanRecorder:
+    """The process-current recorder (created on first use)."""
+    global _current
+    with _current_lock:
+        if _current is None:
+            _current = SpanRecorder()
+        return _current
+
+
+def span(name: str, **attrs):
+    """Record a span on the process-current recorder."""
+    return current_recorder().span(name, **attrs)

@@ -151,7 +151,11 @@ def aggregate_goodput(
             health[key] += int(rec.get("health", {}).get(key, 0))
     total_wall = totals["wall_s"] + downtime_s
     goodput = totals["step_s"] / total_wall if total_wall > 0 else 0.0
-    return {
+    # records written since the obs bus exist carry the run identity; the
+    # aggregate surfaces it when every stamped record agrees (old,
+    # unstamped records aggregate exactly as before)
+    run_ids = {r["run_id"] for r in records if r.get("run_id")}
+    out = {
         "metric": "train_goodput",
         "goodput_frac": round(goodput, 4),
         "productive_s": round(totals["step_s"], 3),
@@ -166,6 +170,9 @@ def aggregate_goodput(
         "health": health,
         "attempt_records": records,
     }
+    if len(run_ids) == 1:
+        out["run_id"] = next(iter(run_ids))
+    return out
 
 
 def write_goodput(path: str | Path, report: dict) -> Path:

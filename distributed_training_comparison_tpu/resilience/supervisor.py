@@ -61,6 +61,7 @@ class Supervisor:
         sleep: Callable[[float], None] = time.sleep,
         log: Callable[[str], None] | None = None,
         progress: Callable[[], object] | None = None,
+        events: Callable[..., object] | None = None,
     ) -> None:
         self._cmd = cmd
         self._env = env
@@ -72,6 +73,11 @@ class Supervisor:
         self._sleep = sleep
         self._log = log or (lambda msg: print(f"[supervisor] {msg}", file=sys.stderr))
         self._progress = progress
+        # event hook (obs run-event bus): called as
+        # events(kind, **payload) at attempt start/end and backoff, so the
+        # restart loop itself shows up on the unified timeline.  Optional —
+        # the Supervisor stays importable without the obs package wired.
+        self._events = events or (lambda kind, **payload: None)
 
     def _resolve(self, attempt: int) -> tuple[list[str], dict | None]:
         cmd = self._cmd(attempt) if callable(self._cmd) else self._cmd
@@ -95,10 +101,15 @@ class Supervisor:
         prev_marker = self._progress() if self._progress is not None else None
         while True:
             cmd, env = self._resolve(attempt)
+            self._events("attempt_start", attempt=attempt)
             t0 = time.monotonic()
             rc = self._runner(cmd, env)
             seconds = time.monotonic() - t0
             preempted = rc == self.preempt_exit_code
+            self._events(
+                "attempt_end", attempt=attempt, returncode=rc,
+                seconds=round(seconds, 3), preempted=preempted,
+            )
             attempts.append(
                 {
                     "attempt": attempt,
@@ -133,6 +144,10 @@ class Supervisor:
                 self._log(
                     f"giving up after {len(attempts) - 1} restarts (last rc={rc})"
                 )
+                self._events(
+                    "give_up", attempt=attempt, returncode=rc,
+                    restarts=len(attempts) - 1,
+                )
                 break
             if preempted:
                 # the machine went away, not the code: relaunch immediately
@@ -150,6 +165,10 @@ class Supervisor:
                     f"attempt {attempt} failed (rc={rc}); backing off "
                     f"{backoff:.1f}s then restarting "
                     f"({budget_used}/{self.max_restarts}){note}"
+                )
+                self._events(
+                    "backoff", attempt=attempt, seconds=backoff,
+                    progressed=progressed,
                 )
                 self._sleep(backoff)
                 downtime += backoff
@@ -190,6 +209,9 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
     ``sys.argv[0]`` (the backend's ``main.py``), the one invocation shape in
     which "run myself again" is well-defined.
     """
+    import os
+
+    from .. import obs
     from .goodput import aggregate_goodput, collect_goodput_records, write_goodput
 
     argv = list(sys.argv[1:] if argv is None else argv)
@@ -197,6 +219,24 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
     for extra in ("--auto-resume", "--resilience"):
         if extra not in child_args:
             child_args.append(extra)
+
+    # One run_id for the whole supervised run, generated here (or inherited
+    # — a supervisor may itself run under one) and exported into every
+    # attempt's environment with its restart index, so all attempts' event
+    # and goodput records join on it.  The supervisor's own events (attempt
+    # launches, backoffs) land in the ckpt root's events.jsonl — run_report
+    # merges them with the per-attempt files in the version dirs.
+    run_id = os.environ.get(obs.RUN_ID_ENV) or obs.new_run_id()
+    obs_enabled = getattr(hparams, "obs", True)
+    bus = obs.configure(run_id=run_id, persist=obs_enabled)
+    if obs_enabled:
+        bus.bind_dir(hparams.ckpt_path)
+
+    def env_for(attempt: int) -> dict:
+        env = dict(os.environ)
+        env[obs.RUN_ID_ENV] = run_id
+        env[obs.ATTEMPT_ENV] = str(attempt)
+        return env
 
     def cmd_for(attempt: int) -> list[str]:
         # An explicit --resume belongs to attempt 0: it resumes the
@@ -230,9 +270,11 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
 
     sup = Supervisor(
         cmd_for,
+        env=env_for,
         max_restarts=getattr(hparams, "max_restarts", 3),
         backoff_base=getattr(hparams, "restart_backoff", 1.0),
         progress=progress_probe,
+        events=lambda kind, **payload: bus.emit(kind, **payload),
     )
     t_start = time.time()
     summary = sup.run()
@@ -248,8 +290,17 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
         restarts=summary["restarts"],
         preemptions=summary["preemptions"],
     )
+    report.setdefault("run_id", run_id)
     out_path = getattr(hparams, "goodput_json", None) or "GOODPUT.json"
     write_goodput(out_path, report)
+    bus.emit(
+        "run_summary",
+        final_rc=summary["final_rc"],
+        restarts=summary["restarts"],
+        preemptions=summary["preemptions"],
+        goodput_frac=report["goodput_frac"],
+    )
+    obs.reset(bus)
     return {
         "supervisor": summary,
         "goodput": report,

@@ -186,4 +186,12 @@ def serve_main(hparams) -> dict:
     report["engine"] = engine.stats()
     if is_main_process():
         metrics.write_tensorboard(Path(hparams.ckpt_path) / "serve-tb")
+        # one summary record on the unified run-event bus: a serving
+        # session's artifacts join training's on the same timeline schema
+        # (ckpt-root events.jsonl, next to the supervisor's)
+        from .. import obs
+
+        if getattr(hparams, "obs", True):
+            obs.current_bus().bind_dir(hparams.ckpt_path)
+        metrics.emit_event(obs.current_bus())
     return report

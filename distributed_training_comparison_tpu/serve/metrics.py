@@ -116,6 +116,12 @@ class ServeMetrics:
         )
         return s
 
+    def emit_event(self, bus) -> dict:
+        """One ``serve`` record on the run-event bus (obs/): the same
+        summary the log line and the TB scalars carry, on the unified
+        timeline schema run_report merges."""
+        return bus.emit("serve", **self.summary())
+
     def write_tensorboard(self, log_dir: str | Path, step: int = 0) -> None:
         """Write the summary as TB scalars through the framework's own
         event writer (``utils/tensorboard.py``) — readable by any stock

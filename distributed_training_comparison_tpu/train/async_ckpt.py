@@ -27,6 +27,8 @@ import threading
 import time
 from typing import Callable
 
+from ..obs import span as _obs_span
+
 
 class AsyncCheckpointer:
     """One background writer thread executing queued checkpoint jobs.
@@ -44,6 +46,7 @@ class AsyncCheckpointer:
         self._lock = threading.Lock()
         self._errors: list[BaseException] = []
         self._busy_s = 0.0  # wall-clock the worker spent executing jobs
+        self._depth = 0     # jobs submitted but not yet finished
         self._born = time.monotonic()
         self._thread = threading.Thread(
             target=self._worker, name="dtc-ckpt-writer", daemon=True
@@ -63,28 +66,34 @@ class AsyncCheckpointer:
             t0 = time.monotonic()
             try:
                 if job is not None:  # None => superseded, already written
-                    job()
+                    with _obs_span("ckpt_write", key=key):
+                        job()
             except BaseException as e:  # surfaced on wait()/close()
                 with self._lock:
                     self._errors.append(e)
             finally:
                 with self._lock:
                     self._busy_s += time.monotonic() - t0
+                    self._depth -= 1
                 self._q.task_done()
 
     def stats(self) -> dict:
-        """Writer-thread utilization gauge for goodput records: busy seconds
-        (fetch+serialize+write inside jobs) over thread lifetime.  A busy
-        fraction approaching 1.0 means write-behind has stopped hiding the
-        checkpoint cost — saves are queueing faster than they drain, and the
-        next ``wait()`` will block the epoch loop for real."""
+        """Writer-thread utilization gauges for goodput records and the
+        periodic ``writer`` events: busy seconds (fetch+serialize+write
+        inside jobs) over thread lifetime, plus the instantaneous queue
+        depth (jobs submitted and not yet finished).  A busy fraction
+        approaching 1.0 — or a depth that climbs epoch over epoch — means
+        write-behind has stopped hiding the checkpoint cost: saves queue
+        faster than they drain, and the next ``wait()`` will block the
+        epoch loop for real."""
         alive = max(time.monotonic() - self._born, 1e-9)
         with self._lock:
-            busy = self._busy_s
+            busy, depth = self._busy_s, self._depth
         return {
             "busy_s": round(busy, 4),
             "alive_s": round(alive, 4),
             "busy_frac": round(min(busy / alive, 1.0), 4),
+            "queue_depth": depth,
         }
 
     def submit(self, job: Callable[[], object], key: str = "default") -> None:
@@ -92,6 +101,7 @@ class AsyncCheckpointer:
         queued-but-unstarted ones."""
         with self._lock:
             self._latest[key] = job
+            self._depth += 1
         self._q.put(key)
 
     def _raise_collected(self) -> None:

@@ -32,13 +32,16 @@ TPU-native structure of ``fit``:
 
 from __future__ import annotations
 
+import os
 import time
+from contextlib import nullcontext
 from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..config import (
     DEVICE_PREFETCH_DEFAULT,
     HOST_CHUNK_STEPS_DEFAULT,
@@ -114,6 +117,12 @@ class Trainer:
         self.preempt_handler = None
         if getattr(hparams, "resilience", False) or self.fault_plan is not None:
             self.preempt_handler = PreemptionHandler().install()
+        # --- observability (obs/): the run-event bus + span recorder for
+        # this attempt.  run_id comes from the environment (the supervisor
+        # hands every attempt the same one) or is generated here; under
+        # multi-host every process takes process 0's — a COLLECTIVE, like
+        # the save-throttle broadcast, so it runs before any other one.
+        self._setup_obs(hparams)
         # step faults (nan_grad/bad_batch/loss_spike) trace an extra fault
         # argument into the compiled runners; built only when the plan
         # carries them so the normal executables are unchanged
@@ -452,6 +461,18 @@ class Trainer:
         self.version = (
             int(self.version_dir.name.split("-")[1]) if self.version_dir else -1
         )
+        # Every process can name this attempt's event dir — the resumed
+        # run's version dir, the multi-host agreed fresh dir, or (single
+        # process) the claimed one — so per-process event files land next
+        # to the checkpoints, where run_report merges them.  Events emitted
+        # before this point (construction) flush from the bus's buffer now.
+        self._obs_dir = (
+            Path(hparams.resume).parent
+            if auto_resumed
+            else (self.version_dir if self.is_main else agreed_dir)
+        )
+        if self._obs_enabled and self._obs_dir is not None:
+            self.bus.bind_dir(self._obs_dir)
 
         # mid-epoch resume (host data mode): a checkpoint drained at a chunk
         # boundary records how many steps of the in-progress epoch it holds;
@@ -517,7 +538,8 @@ class Trainer:
         self.watchdog = None
         if getattr(hparams, "health", True):
             self.watchdog = Watchdog(
-                HealthConfig.from_hparams(hparams), logger=self.logger
+                HealthConfig.from_hparams(hparams), logger=self.logger,
+                bus=self.bus,
             )
         self._fingerprint_fn = None  # jitted lazily on first desync check
         self._epoch_health: dict = {}
@@ -525,15 +547,81 @@ class Trainer:
         # step-time breakdown (h2d-wait / dispatch / compute): per-epoch
         # meter + run totals for the goodput record; the snapshot program
         # (device-side state copy for the async writer) compiles lazily
-        self._step_meter = StepTimeMeter()
+        self._step_meter = StepTimeMeter(tracer=self.tracer)
         self._overlap_totals = StepTimeMeter()
         self._snapshot_fn = None
+        self._profiling = False  # True only during the --profile-dir epoch
 
         # init/recovery cost: construction through restore + program builds
         # — the price every restart pays again, charged against goodput
         self._init_secs = time.monotonic() - self._t_construct
+        self.bus.emit(
+            "run_start",
+            epoch=self.start_epoch,
+            model=hparams.model,
+            backend=hparams.backend,
+            version=self.version,
+            epochs=hparams.epoch,
+            steps_per_epoch=self.steps_per_epoch,
+            batch_size=hparams.batch_size,
+            mesh=dict(self.mesh.shape),
+            data_mode=self.data_mode,
+            precision=self.precision,
+            resumed=bool(getattr(hparams, "resume", None)),
+            resume_step_offset=self._resume_step_offset,
+            init_s=round(self._init_secs, 4),
+        )
 
     # ------------------------------------------------------------------ utils
+
+    def _setup_obs(self, hparams) -> None:
+        """Install this attempt's event bus + span recorder as the
+        process-current ones (obs/).
+
+        The run identity: ``run_id`` names the whole supervised run — the
+        supervisor exports it (and the attempt index) into every child's
+        environment, so records written by different attempts join on it;
+        an unsupervised run generates a fresh one.  Under multi-host every
+        process takes process 0's id/attempt (one tiny broadcast — the
+        collective runs identically on every process, BEFORE the
+        auto-resume agreement broadcast below).
+        """
+        self._obs_enabled = getattr(hparams, "obs", True)
+        run_id = os.environ.get(obs.RUN_ID_ENV) or obs.new_run_id()
+        attempt = int(os.environ.get(obs.ATTEMPT_ENV, "0") or 0)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            token = np.frombuffer(
+                run_id.encode("ascii", "replace")[:32].ljust(32), np.uint8
+            ).copy()
+            token = multihost_utils.broadcast_one_to_all(token)
+            run_id = token.tobytes().decode("ascii", "ignore").strip()
+            attempt = int(
+                multihost_utils.broadcast_one_to_all(np.asarray(attempt))
+            )
+        self.bus = obs.configure(
+            run_id=run_id,
+            attempt=attempt,
+            process_index=jax.process_index(),
+            ring_size=getattr(hparams, "flight_recorder_size", 256),
+            # --no-obs: ring-only, no pre-bind buffering (the bus will
+            # never be bound, so a pending list would grow for the run)
+            persist=self._obs_enabled,
+        )
+        self.tracer = obs.SpanRecorder(process_index=jax.process_index())
+        self._prev_recorder = obs.set_recorder(self.tracer)
+        self._obs_dir: Path | None = None
+
+    def _ckpt_meta(self) -> dict:
+        """Manifest metadata every resumable save carries: the saving mesh
+        topology (elastic-restore accounting) plus the run identity, so a
+        checkpoint names the run/attempt that wrote it."""
+        return {
+            **elastic.mesh_meta(self.mesh),
+            "run_id": self.bus.run_id,
+            "attempt": self.bus.attempt,
+        }
 
     def _dump_hparams(self) -> None:
         """hparams.yaml provenance dump (reference ``src/single/trainer.py:70-73``)."""
@@ -621,15 +709,24 @@ class Trainer:
             profiling = getattr(hp, "profile_dir", None) and epoch == profile_epoch
             if profiling:
                 jax.profiler.start_trace(hp.profile_dir)
+                # host spans double as device TraceAnnotations for this
+                # epoch, and chunk dispatches gain StepTraceAnnotations —
+                # the xplane capture joins the host timeline on step ids
+                self._profiling = True
+                self.tracer.annotate = True
+            self.bus.emit("epoch_start", epoch=epoch)
             t0 = time.perf_counter()
-            if self.data_mode == "device":
-                losses, top1 = self._train_epoch_device(epoch)
-            else:
-                losses, top1 = self._train_epoch_host(epoch)
+            with self.tracer.span("epoch", epoch=epoch):
+                if self.data_mode == "device":
+                    losses, top1 = self._train_epoch_device(epoch)
+                else:
+                    losses, top1 = self._train_epoch_host(epoch)
             epoch_time = time.perf_counter() - t0
             self.goodput.add("step", epoch_time)
             if profiling:
                 jax.profiler.stop_trace()
+                self._profiling = False
+                self.tracer.annotate = False
                 self.logger.info(f"profiler trace written to {hp.profile_dir}")
             imgs = len(losses) * hp.batch_size
 
@@ -671,7 +768,7 @@ class Trainer:
                 if getattr(hp, "log_every_step", False):
                     self._log_tb("loss/step", float(loss), gstep)
 
-            with self.goodput.phase("eval"):
+            with self.goodput.phase("eval"), self.tracer.span("eval", epoch=epoch):
                 val = self.validate(epoch)
             lr_now = float(self.lr_schedule(epoch * self.steps_per_epoch))
             self.logger.info(
@@ -691,6 +788,17 @@ class Trainer:
                 # compute; near-zero means the chip never waited on data
                 self._log_tb(f"overlap/{phase_name}_s", secs, epoch)
             self._overlap_totals.merge(self._step_meter)
+            self.bus.emit(
+                "epoch_end",
+                epoch=epoch,
+                train_loss=round(meter.avg, 6),
+                val_loss=round(val["val_loss"], 6),
+                val_acc=round(val["val_acc"], 4),
+                lr=lr_now,
+                secs=round(epoch_time, 4),
+                images_per_sec=round(imgs / epoch_time, 2),
+                step_breakdown=self._step_meter.summary(),
+            )
             for k, v in getattr(self, "_moe_health", {}).items():
                 # moe_dropped_frac → moe/dropped_frac, moe_load_max →
                 # moe/load_max: a collapsed router (load_max → 1.0) or
@@ -749,7 +857,9 @@ class Trainer:
                 # state (opt_state included) is gathered only when the
                 # resumable last.ckpt is due — halves the DCN volume on
                 # best-improvement epochs.
-                with self.goodput.phase("ckpt"):
+                with self.goodput.phase("ckpt"), self.tracer.span(
+                    "ckpt_fetch", epoch=epoch
+                ):
                     if want_last:
                         state_ref = fetch_to_host(state_ref)
                     else:
@@ -764,7 +874,9 @@ class Trainer:
                 # dispatched async; a computation, so under multi-host it
                 # runs on EVERY process), never a reference donation would
                 # invalidate mid-fetch.
-                with self.goodput.phase("ckpt"):
+                with self.goodput.phase("ckpt"), self.tracer.span(
+                    "ckpt_snapshot", epoch=epoch
+                ):
                     state_ref = self._snapshot_state(state_ref)
             if self.is_main:
                 # write-behind: the worker thread fetches + serializes while
@@ -789,11 +901,19 @@ class Trainer:
                             ckpt.save_resume_state(
                                 vdir, s, e, b,
                                 fault_hook=h,
-                                meta=elastic.mesh_meta(self.mesh),
+                                meta=self._ckpt_meta(),
                             )
                         ),
                         key="last",
                     )
+            if self.ckpt_writer is not None:
+                # periodic writer gauge: queue depth climbing epoch over
+                # epoch (or busy_frac → 1.0) means write-behind stopped
+                # hiding the checkpoint cost
+                wstats = self.ckpt_writer.stats()
+                self.bus.emit("writer", epoch=epoch, **wstats)
+                self._log_tb("ckpt/writer_busy_frac", wstats["busy_frac"], epoch)
+                self._log_tb("ckpt/queue_depth", wstats["queue_depth"], epoch)
             self._log_tb(
                 "goodput/productive_frac", self.goodput.productive_frac(), epoch
             )
@@ -815,11 +935,17 @@ class Trainer:
         if bar is not None:
             bar.close()
         if self.ckpt_writer is not None:
-            with self.goodput.phase("ckpt"):
+            with self.goodput.phase("ckpt"), self.tracer.span("ckpt_drain"):
                 self.ckpt_writer.wait()
         self.logger.info(
             f"[{hp.backend.upper()} Version {self.version}] done in "
             f"{time.perf_counter() - t_start:.1f}s, best val acc {self.best_acc:.2f}%"
+        )
+        self.bus.emit(
+            "run_end",
+            epoch=hp.epoch - 1,
+            best_acc=round(self.best_acc, 4),
+            wall_s=round(time.perf_counter() - t_start, 4),
         )
         self._write_goodput()
         return self.version
@@ -863,6 +989,10 @@ class Trainer:
             f"aborting; last saved state: {last_good or 'none'}"
         )
         self.logger.error(msg)
+        # flight recorder: the abort is exactly the moment a post-mortem
+        # wants the final ring of events for
+        self.bus.emit("abort", epoch=epoch, step=bad, reason=msg)
+        self.bus.dump_crash(msg, directory=self._obs_dir)
         raise FloatingPointError(msg)
 
     def _health_check(self, epoch: int, losses, epoch_time: float) -> int | None:
@@ -918,11 +1048,15 @@ class Trainer:
                     epoch, losses,
                     note=f" after {self.watchdog.rollbacks} rollbacks",
                 )
-            raise RuntimeError(
+            msg = (
                 f"health watchdog: rollback budget "
                 f"({cfg.max_rollbacks}) exhausted at epoch {epoch}: {reason}"
             )
-        next_epoch = self._rollback(epoch, epoch_time, reason)
+            self.bus.emit("abort", epoch=epoch, reason=msg)
+            self.bus.dump_crash(msg, directory=self._obs_dir)
+            raise RuntimeError(msg)
+        with self.tracer.span("rollback", epoch=epoch):
+            next_epoch = self._rollback(epoch, epoch_time, reason)
         if next_epoch is None:  # nothing to roll back to
             if verdict.nonfinite or verdict.skipped:
                 self._abort_nonfinite(
@@ -1115,6 +1249,10 @@ class Trainer:
             f"preemption at end of epoch {epoch}: draining checkpoints, "
             f"then exiting with code {EXIT_PREEMPTED} for the supervisor"
         )
+        self.bus.emit(
+            "preempt", epoch=epoch,
+            step=(epoch + 1) * self.steps_per_epoch, mid_epoch=False,
+        )
         if getattr(self.hparams, "save_last", True) and not already_saved:
             if sync_fetch:  # throttled epochs skipped the symmetric fetch
                 with self.goodput.phase("ckpt"):
@@ -1124,7 +1262,7 @@ class Trainer:
                     lambda s=state_ref, e=epoch, b=self.best_acc: (
                         ckpt.save_resume_state(
                             self.version_dir, s, e, b,
-                            meta=elastic.mesh_meta(self.mesh),
+                            meta=self._ckpt_meta(),
                         )
                     ),
                     key="last",
@@ -1152,6 +1290,10 @@ class Trainer:
             f"checkpoints, then exiting with code {EXIT_PREEMPTED} for the "
             "supervisor"
         )
+        self.bus.emit(
+            "preempt", epoch=epoch,
+            step=epoch * self.steps_per_epoch + steps_done, mid_epoch=True,
+        )
         state_ref = self.state
         sync_fetch = jax.process_count() > 1 and needs_collective_fetch(state_ref)
         if getattr(self.hparams, "save_last", True):
@@ -1164,7 +1306,7 @@ class Trainer:
                         ckpt.save_resume_state(
                             self.version_dir, s, e - 1, b,
                             meta={
-                                **elastic.mesh_meta(self.mesh),
+                                **self._ckpt_meta(),
                                 "epoch_in_progress": e,
                                 "epoch_steps_done": n,
                             },
@@ -1194,6 +1336,10 @@ class Trainer:
             version=self.version,
             topology=elastic.topology(),
             start_epoch=self.start_epoch,
+            # the unified-timeline join keys (obs/): every attempt record
+            # names the run and restart index that produced it
+            run_id=self.bus.run_id,
+            attempt=self.bus.attempt,
             # lets the supervisor aggregate only ITS run's attempts when
             # the ckpt root also holds older runs' version dirs
             written_at=time.time(),
@@ -1209,6 +1355,13 @@ class Trainer:
             # writer-thread utilization: visible when write-behind stops
             # hiding the device→host fetch + serialize cost
             record["ckpt_writer"] = self.ckpt_writer.stats()
+        # the attempt's phase totals (+ breakdown/writer/health gauges) on
+        # the unified timeline — run_report reads goodput straight off the
+        # event stream
+        self.bus.emit(
+            "goodput",
+            **{k: v for k, v in record.items() if k not in self.bus.stamp()},
+        )
         try:
             goodput_mod.append_goodput_record(
                 self.version_dir / "goodput.jsonl", record
@@ -1285,7 +1438,15 @@ class Trainer:
                 epoch_arr,
                 jnp.asarray(done),
             )
-            with meter.phase("dispatch"):
+            # a --profile-dir capture gets one StepTraceAnnotation per
+            # chunk dispatch: the xplane gains step boundaries, so device
+            # time joins the host spans (and op_profile output) by step id
+            ann = (
+                obs.step_annotation(epoch * steps + done)
+                if self._profiling
+                else nullcontext()
+            )
+            with ann, meter.phase("dispatch"):
                 if fault is not None:
                     self.state, metrics = runner(*args, fault)
                 else:
@@ -1403,7 +1564,14 @@ class Trainer:
             while done < steps:
                 with meter.phase("h2d_wait"):
                     start, take, batch = next(chunks)
-                with meter.phase("dispatch"):
+                # step boundaries for a --profile-dir capture (see the
+                # device-mode loop)
+                ann = (
+                    obs.step_annotation(epoch * steps + start)
+                    if self._profiling
+                    else nullcontext()
+                )
+                with ann, meter.phase("dispatch"):
                     args = (
                         self.state, batch["x"], batch["y"],
                         epoch_key, jnp.asarray(start),
@@ -1528,3 +1696,21 @@ class Trainer:
             self.ckpt_writer.close()
         if self.writer is not None:
             self.writer.close()
+        # obs teardown: export this attempt's host spans as a Chrome trace
+        # next to its events, then release the process-current bus/recorder
+        # (sequential Trainers in one process must not cross-write)
+        if self._obs_enabled and self._obs_dir is not None:
+            obs.write_chrome_trace(
+                self._obs_dir
+                / obs.trace_filename(self.bus.attempt, self.bus.process_index),
+                self.tracer,
+                label=f"run {self.bus.run_id} attempt {self.bus.attempt} "
+                f"process {self.bus.process_index}",
+            )
+            if self.tracer.dropped:
+                self.logger.warning(
+                    f"span trace truncated: {self.tracer.dropped} spans "
+                    f"dropped past the {self.tracer.max_spans}-span cap"
+                )
+        obs.set_recorder(self._prev_recorder)
+        obs.reset(self.bus)

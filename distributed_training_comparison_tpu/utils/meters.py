@@ -11,7 +11,7 @@ is a reference bottleneck we do not replicate, see
 from __future__ import annotations
 
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 
 
 class AverageMeter:
@@ -58,7 +58,12 @@ class StepTimeMeter:
 
     PHASES = ("h2d_wait", "dispatch", "compute")
 
-    def __init__(self) -> None:
+    def __init__(self, tracer=None) -> None:
+        # optional span recorder (obs/spans.py): when set, every phase()
+        # interval is ALSO recorded as a host span, so the Chrome-trace
+        # export shows the same h2d_wait/dispatch/compute breakdown the
+        # scalar totals summarize
+        self.tracer = tracer
         self.reset()
 
     def reset(self) -> None:
@@ -70,9 +75,11 @@ class StepTimeMeter:
 
     @contextmanager
     def phase(self, name: str):
+        ctx = self.tracer.span(name) if self.tracer is not None else nullcontext()
         t0 = time.perf_counter()
         try:
-            yield
+            with ctx:
+                yield
         finally:
             self.add(name, time.perf_counter() - t0)
 

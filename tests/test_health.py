@@ -804,3 +804,4 @@ def test_bench_health_leg_writes_report(tmp_path):
     assert record["rollbacks"] == 2 and record["skipped_steps"] == 3
     assert record["goodput"]["rollback_s"] > 0
     assert record["goodput"]["goodput_frac"] > 0
+    assert record["events_check_rc"] == 0  # the capture self-validated

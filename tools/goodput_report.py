@@ -65,6 +65,15 @@ def format_table(reports: list[tuple[str, dict]]) -> str:
             f" {100 * goodput:7.1f}%"
             f" {delta:>8}"
         )
+    # records written since the run-event bus exists carry the run
+    # identity (obs/); older records aggregate identically without it
+    tagged = [
+        (name, rep["run_id"], rep.get("attempts", 0))
+        for name, rep in reports
+        if rep.get("run_id")
+    ]
+    for name, run_id, attempts in tagged:
+        lines.append(f"  {name}: run {run_id} ({attempts} attempt(s))")
     return "\n".join(lines)
 
 

@@ -84,10 +84,20 @@ def format_table(reports: list[tuple[str, dict]]) -> str:
             f" {delta:>8}"
         )
     tail = []
+    # events written since the run-event bus exists carry an identity
+    # stamp (obs/: v/run_id/attempt/process_index/t_wall); older records
+    # have none — both shapes are summarized identically, and the echo
+    # below folds the stamp to an "a{attempt}" prefix instead of dumping it
+    stamp_keys = ("v", "run_id", "process_index", "t_wall", "attempt")
     for name, rep in reports:
         events = rep.get("events") or []
+        run_ids = {e["run_id"] for e in events if e.get("run_id")}
+        if run_ids:
+            tail.append(f"  [{name}] run {'+'.join(sorted(run_ids))}")
         for ev in events[-TAIL_EVENTS:]:
-            tail.append(f"  [{name}] {json.dumps(ev)}")
+            prefix = f"a{ev['attempt']} " if "attempt" in ev else ""
+            bare = {k: v for k, v in ev.items() if k not in stamp_keys}
+            tail.append(f"  [{name}] {prefix}{json.dumps(bare)}")
     if tail:
         lines.append("")
         lines.append(f"last events (up to {TAIL_EVENTS} per report):")

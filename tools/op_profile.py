@@ -14,6 +14,13 @@ memory-bound.  Uses the tensorflow profiler's converter when available
 Reference has no profiling at all (SURVEY.md §5); this closes the loop on
 the capture side's ``--profile-dir``.
 
+The profiled epoch's chunk dispatches are wrapped in
+``StepTraceAnnotation("train", step_num=<global step>)`` and its host
+spans double as ``TraceAnnotation``s (obs/spans.py), so the xplane this
+tool reads carries step boundaries that join the Chrome-trace host
+timeline (``version-*/trace.json``) on step ids — device time and host
+staging/checkpointing are two views of the same clock.
+
 Example (ResNet-18/bs256/bf16 on one v5e): convolution fusions are ~85% of
 non-idle device time at ~0.51 HBM utilization — the 32×32 workload is
 partly memory-bound, so the measured 59.5% MFU is near the practical

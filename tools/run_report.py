@@ -1,0 +1,385 @@
+"""Merge a run's event stream into one timeline + summary; validate it.
+
+Usage::
+
+    python tools/run_report.py CKPT_ROOT              # summary + timeline
+    python tools/run_report.py CKPT_ROOT --check      # schema validation
+    python tools/run_report.py RUN_A RUN_B --diff     # compare two runs
+    python tools/run_report.py version-0/events.jsonl --timeline 50
+
+``CKPT_ROOT`` is a training run's checkpoint root: every ``events*.jsonl``
+under it — the supervisor's at the root, each attempt's (and, multi-host,
+each process's) in the ``version-*`` dirs — is merged into ONE timeline
+ordered by wall clock, with per-attempt summaries: epochs trained, goodput
+phases, rollback causes, preemption points, checkpoint-writer busy
+fraction, and h2d wait.  A version dir or a single jsonl file also works.
+
+``--check`` validates every record against the versioned event schema
+(``obs/bus.py``) and exits nonzero on any violation — bench legs run it so
+a capture self-validates before anyone trusts the numbers.
+
+``--diff`` compares the FIRST run against the second: the question an
+observability change answers is "did the second run absorb the same
+faults with less waste".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_comparison_tpu.obs import (  # noqa: E402
+    load_events,
+    validate_event,
+)
+
+TIMELINE_TAIL = 20
+# supervisor-side kinds: their envelope attempt is the supervisor's own
+# (0); the payload names the child attempt they concern
+SUPERVISOR_KINDS = {
+    "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
+}
+
+
+def find_event_files(path: str | Path) -> list[Path]:
+    p = Path(path)
+    if p.is_file():
+        return [p]
+    return sorted(p.glob("events*.jsonl")) + sorted(
+        p.glob("version-*/events*.jsonl")
+    )
+
+
+def load_run(path: str | Path) -> tuple[list[dict], list[Path]]:
+    """All events under ``path``, merged and wall-clock ordered."""
+    files = find_event_files(path)
+    events: list[dict] = []
+    for f in files:
+        events.extend(load_events(f))
+    events.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)))
+    return events, files
+
+
+def check_run(path: str | Path, counts: list | None = None) -> list[str]:
+    """Schema violations across every event file under ``path`` (one read
+    per file).  ``counts``, when given, receives the per-file parsed-event
+    counts so the caller can report totals without re-reading."""
+    problems: list[str] = []
+    files = find_event_files(path)
+    if not files:
+        problems.append(f"{path}: no events*.jsonl found")
+        return problems
+    for f in files:
+        parsed: list[dict] = []
+        torn = 0
+        for line in f.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                parsed.append(json.loads(line))
+            except ValueError:
+                torn += 1
+        if torn:
+            problems.append(f"{f}: {torn} unparseable line(s)")
+        for i, ev in enumerate(parsed):
+            for err in validate_event(ev):
+                problems.append(f"{f}:{i + 1}: {err}")
+        if counts is not None:
+            counts.append(len(parsed))
+    return problems
+
+
+# ----------------------------------------------------------------- summary
+
+
+def _payload(ev: dict) -> dict:
+    return ev.get("payload") or {}
+
+
+def summarize(events: list[dict]) -> dict:
+    """Fold one run's merged events into per-attempt and overall stats."""
+    attempts: dict[int, dict] = defaultdict(
+        lambda: {
+            "epochs": 0, "rollbacks": 0, "rollback_causes": [],
+            "skips": 0, "spikes": 0, "desyncs": 0, "aborts": [],
+            "preempt": None, "goodput": None, "writer": None,
+            "t_first": None, "t_last": None, "processes": set(),
+        }
+    )
+    run_ids: set[str] = set()
+    supervisor: list[dict] = []
+    for ev in events:
+        if ev.get("run_id"):
+            run_ids.add(ev["run_id"])
+        kind = ev.get("kind")
+        if kind in SUPERVISOR_KINDS:
+            supervisor.append(ev)
+            continue
+        a = attempts[int(ev.get("attempt", 0))]
+        t = ev.get("t_wall")
+        if t is not None:
+            a["t_first"] = t if a["t_first"] is None else min(a["t_first"], t)
+            a["t_last"] = t if a["t_last"] is None else max(a["t_last"], t)
+        a["processes"].add(int(ev.get("process_index", 0)))
+        if int(ev.get("process_index", 0)) != 0:
+            # every process emits the same trainer/watchdog events into its
+            # own file; count each occurrence once (process 0's) so a
+            # 2-host attempt doesn't report doubled epochs/rollbacks
+            continue
+        p = _payload(ev)
+        if kind == "epoch_end":
+            a["epochs"] += 1
+        elif kind == "rollback":
+            a["rollbacks"] += 1
+            if p.get("reason"):
+                a["rollback_causes"].append(
+                    f"epoch {ev.get('epoch', '?')}: {p['reason']}"
+                )
+        elif kind == "skip":
+            a["skips"] += int(p.get("count", 1))
+        elif kind == "spike":
+            a["spikes"] += int(p.get("count", 1))
+        elif kind == "desync":
+            a["desyncs"] += 1
+        elif kind == "abort":
+            a["aborts"].append(p.get("reason", ""))
+        elif kind == "preempt":
+            a["preempt"] = {
+                "epoch": ev.get("epoch"), "step": ev.get("step"),
+                "mid_epoch": p.get("mid_epoch"),
+            }
+        elif kind == "goodput":
+            a["goodput"] = p
+        elif kind == "writer":
+            a["writer"] = p  # last one wins (latest gauge)
+    overall = {
+        "run_ids": sorted(run_ids),
+        "attempts": {k: attempts[k] for k in sorted(attempts)},
+        "supervisor": supervisor,
+        "events": len(events),
+        "rollbacks": sum(a["rollbacks"] for a in attempts.values()),
+        "epochs": sum(a["epochs"] for a in attempts.values()),
+        "preemptions": sum(
+            1 for a in attempts.values() if a["preempt"] is not None
+        ),
+        "productive_s": sum(
+            float((a["goodput"] or {}).get("step_s", 0.0))
+            for a in attempts.values()
+        ),
+        "wall_s": sum(
+            float((a["goodput"] or {}).get("wall_s", 0.0))
+            for a in attempts.values()
+        ),
+        "h2d_wait_s": sum(
+            float(
+                ((a["goodput"] or {}).get("step_breakdown") or {}).get(
+                    "h2d_wait_s", 0.0
+                )
+            )
+            for a in attempts.values()
+        ),
+    }
+    overall["goodput_frac"] = (
+        overall["productive_s"] / overall["wall_s"]
+        if overall["wall_s"] > 0
+        else 0.0
+    )
+    return overall
+
+
+def format_summary(name: str, s: dict) -> str:
+    lines = [
+        f"run {'+'.join(s['run_ids']) or '?'} — {len(s['attempts'])} "
+        f"attempt(s), {s['events']} events ({name})"
+    ]
+    header = (
+        f"{'attempt':>7} {'procs':>5} {'epochs':>6} {'wall':>9} "
+        f"{'goodput':>8} {'rollbk':>6} {'skips':>5} {'spikes':>6} "
+        f"{'preempt':>12} {'wr.busy':>7} {'wr.q':>4} {'h2d_wait':>9}"
+    )
+    lines += [header, "-" * len(header)]
+    for idx, a in s["attempts"].items():
+        gp = a["goodput"] or {}
+        wall = (
+            gp.get("wall_s")
+            if gp.get("wall_s") is not None
+            else (
+                (a["t_last"] - a["t_first"])
+                if a["t_first"] is not None
+                else 0.0
+            )
+        )
+        writer = a["writer"] or gp.get("ckpt_writer") or {}
+        pre = a["preempt"]
+        pre_str = (
+            "-"
+            if pre is None
+            else f"e{pre['epoch']}" + (
+                f"@s{pre['step']}" if pre.get("mid_epoch") else ""
+            )
+        )
+        h2d = float((gp.get("step_breakdown") or {}).get("h2d_wait_s", 0.0))
+        frac = gp.get("productive_frac")
+        frac_str = f"{100 * frac:7.1f}%" if frac is not None else f"{'?':>8}"
+        lines.append(
+            f"{idx:>7} {len(a['processes']):>5} {a['epochs']:>6}"
+            f" {wall or 0.0:>8.1f}s {frac_str}"
+            f" {a['rollbacks']:>6} {a['skips']:>5} {a['spikes']:>6}"
+            f" {pre_str:>12}"
+            f" {100 * float(writer.get('busy_frac', 0.0)):>6.1f}%"
+            f" {writer.get('queue_depth', 0):>4}"
+            f" {h2d:>8.2f}s"
+        )
+    for idx, a in s["attempts"].items():
+        for cause in a["rollback_causes"]:
+            lines.append(f"  rollback (attempt {idx}) {cause}")
+        for reason in a["aborts"]:
+            lines.append(f"  abort (attempt {idx}) {reason}")
+    if s["supervisor"]:
+        sup = ", ".join(
+            f"{e['kind']}[a{_sup_attempt(e)}]" for e in s["supervisor"]
+        )
+        lines.append(f"  supervisor: {sup}")
+    lines.append(
+        f"  overall: {s['epochs']} epochs over {len(s['attempts'])} "
+        f"attempt(s), goodput {100 * s['goodput_frac']:.1f}%, "
+        f"{s['rollbacks']} rollback(s), {s['preemptions']} preemption(s)"
+    )
+    return "\n".join(lines)
+
+
+def _sup_attempt(ev: dict):
+    return _payload(ev).get("attempt", "?")
+
+
+# ---------------------------------------------------------------- timeline
+
+
+def format_timeline(events: list[dict], tail: int = TIMELINE_TAIL) -> str:
+    if not events:
+        return "(no events)"
+    t0 = events[0].get("t_wall", 0.0)
+    lines = []
+    shown = events[-tail:] if tail and tail > 0 else events
+    if len(shown) < len(events):
+        lines.append(f"... ({len(events) - len(shown)} earlier events)")
+    for ev in shown:
+        where = f"a{ev.get('attempt', '?')}/p{ev.get('process_index', '?')}"
+        at = ""
+        if "epoch" in ev:
+            at = f" epoch={ev['epoch']}"
+            if "step" in ev:
+                at += f" step={ev['step']}"
+        p = _payload(ev)
+        brief = ", ".join(
+            f"{k}={p[k]}"
+            for k in list(p)[:4]
+            if not isinstance(p[k], (dict, list))
+        )
+        lines.append(
+            f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s {where:>7}] "
+            f"{ev.get('kind', '?')}{at}"
+            + (f"  ({brief})" if brief else "")
+        )
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------------------- diff
+
+
+def format_diff(name_a: str, a: dict, name_b: str, b: dict) -> str:
+    rows = [
+        ("attempts", len(a["attempts"]), len(b["attempts"])),
+        ("epochs", a["epochs"], b["epochs"]),
+        ("rollbacks", a["rollbacks"], b["rollbacks"]),
+        ("preemptions", a["preemptions"], b["preemptions"]),
+        ("goodput %", 100 * a["goodput_frac"], 100 * b["goodput_frac"]),
+        ("productive s", a["productive_s"], b["productive_s"]),
+        ("h2d wait s", a["h2d_wait_s"], b["h2d_wait_s"]),
+    ]
+    w = max(len(name_a), len(name_b), 12)
+    lines = [
+        f"{'':<14} {name_a[:w]:>{w}} {name_b[:w]:>{w}} {'Δ':>10}",
+    ]
+    for label, va, vb in rows:
+        delta = vb - va
+        fmt = (
+            (lambda v: f"{v:.1f}")
+            if isinstance(va, float) or isinstance(vb, float)
+            else str
+        )
+        lines.append(
+            f"{label:<14} {fmt(va):>{w}} {fmt(vb):>{w}} {fmt(delta):>10}"
+        )
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------------------- main
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("paths", nargs="+", help="ckpt root / version dir / events jsonl")
+    ap.add_argument(
+        "--check", action="store_true",
+        help="validate every event against the schema; exit 1 on violations",
+    )
+    ap.add_argument(
+        "--diff", action="store_true",
+        help="compare the first two paths' summaries",
+    )
+    ap.add_argument(
+        "--timeline", type=int, default=TIMELINE_TAIL, metavar="N",
+        help=f"show the last N timeline events (0 = all; default {TIMELINE_TAIL})",
+    )
+    args = ap.parse_args(argv)
+
+    if args.check:
+        rc = 0
+        for path in args.paths:
+            counts: list = []
+            problems = check_run(path, counts)
+            if problems:
+                rc = 1
+                for p in problems:
+                    print(f"SCHEMA VIOLATION {p}", file=sys.stderr)
+            else:
+                print(f"{path}: {sum(counts)} events OK")
+        return rc
+
+    if args.diff:
+        if len(args.paths) != 2:
+            print("--diff needs exactly two paths", file=sys.stderr)
+            return 2
+        (na, nb) = args.paths
+        a, _ = load_run(na)
+        b, _ = load_run(nb)
+        if not a or not b:
+            print("--diff: one of the runs has no events", file=sys.stderr)
+            return 2
+        print(format_diff(na, summarize(a), nb, summarize(b)))
+        return 0
+
+    rc = 0
+    for path in args.paths:
+        events, files = load_run(path)
+        if not events:
+            print(f"{path}: no events found", file=sys.stderr)
+            rc = 2
+            continue
+        print(format_summary(str(path), summarize(events)))
+        print()
+        print(format_timeline(events, args.timeline))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
