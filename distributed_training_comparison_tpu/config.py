@@ -582,6 +582,39 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "scraping log files",
     )
     parser.add_argument(
+        "--flight-ring",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="Mirror the flight recorder into an mmap'd fixed-slot "
+        "flight*.ring file next to the event files: the OS page cache "
+        "keeps the slots, so the last N events survive SIGKILL/OOM — the "
+        "deaths crash_dump.json can never catch.  The supervisor pulls "
+        "every host's ring into one blackbox.json after each attempt "
+        "(no-op under --no-obs, which writes no files)",
+    )
+    parser.add_argument(
+        "--metrics-flush-steps",
+        type=int,
+        default=50,
+        metavar="N",
+        help="Per-step sampling budget: grad_norm/loss/step-phase samples "
+        "are recorded into typed in-memory sketches EVERY step, and the "
+        "bus sees one bounded 'metrics' event per N trained steps (plus "
+        "one per epoch end).  Histogram sketches merge associatively "
+        "across flushes/hosts/attempts, so run_report reconstructs "
+        "p50/p95/p99 for any slice of the run from the event stream",
+    )
+    parser.add_argument(
+        "--health-phase-baselines",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="Spike detection keeps a separate median/MAD baseline per LR "
+        "plateau (keyed off the StepLR schedule) instead of one global "
+        "window: the loss distribution shifts at every decay, and a "
+        "post-decay epoch judged against pre-decay losses is a false "
+        "positive waiting to happen",
+    )
+    parser.add_argument(
         "--legacy-test-stats",
         action="store_true",
         default=False,
@@ -622,6 +655,10 @@ def load_config(
     if args.flight_recorder_size < 1:
         parser.error(
             f"--flight-recorder-size must be >= 1, got {args.flight_recorder_size}"
+        )
+    if args.metrics_flush_steps < 1:
+        parser.error(
+            f"--metrics-flush-steps must be >= 1, got {args.metrics_flush_steps}"
         )
     if args.device_chunk_steps < 0:
         parser.error(

@@ -42,6 +42,7 @@ class HealthConfig:
     max_rollbacks: int = 3    # rollback budget per attempt; then abort
     desync_every: int = 1     # fingerprint check every N epochs (0 = off)
     min_baseline: int = 16    # good steps required before spikes can flag
+    phase_baselines: bool = True  # one baseline per LR phase, not global
 
     @classmethod
     def from_hparams(cls, hparams) -> "HealthConfig":
@@ -51,6 +52,7 @@ class HealthConfig:
             bad_steps=getattr(hparams, "health_bad_steps", 3),
             max_rollbacks=getattr(hparams, "health_max_rollbacks", 3),
             desync_every=getattr(hparams, "health_desync_every", 1),
+            phase_baselines=getattr(hparams, "health_phase_baselines", True),
         )
 
 
@@ -92,6 +94,11 @@ class Watchdog:
             threshold_mads=self.cfg.spike_mads,
             min_baseline=self.cfg.min_baseline,
         )
+        # per-phase baselines: losses shift with the LR schedule (a decay
+        # drops the whole distribution), so spike thresholds are kept per
+        # schedule phase — the default detector above serves phase=None
+        # (callers without a schedule, and cfg.phase_baselines=False)
+        self._phase_detectors: dict[str, SpikeDetector] = {}
         self.skipped_steps = 0
         self.spike_steps = 0
         self.rollbacks = 0
@@ -103,14 +110,34 @@ class Watchdog:
 
     # ------------------------------------------------------------ detection
 
+    def _detector_for(self, phase: str | None) -> SpikeDetector:
+        """The spike detector judging ``phase`` (an opaque label the caller
+        derives from the LR schedule — e.g. ``"lr=0.1"``).  Each phase gets
+        its own median/MAD window so a post-decay epoch is never judged
+        against pre-decay losses; ``None`` keeps the single global window."""
+        if phase is None or not self.cfg.phase_baselines:
+            return self.detector
+        det = self._phase_detectors.get(phase)
+        if det is None:
+            det = self._phase_detectors[phase] = SpikeDetector(
+                window=self.cfg.window,
+                threshold_mads=self.cfg.spike_mads,
+                min_baseline=self.cfg.min_baseline,
+            )
+        return det
+
     def observe_epoch(
-        self, epoch: int, losses: np.ndarray, skipped: np.ndarray
+        self,
+        epoch: int,
+        losses: np.ndarray,
+        skipped: np.ndarray,
+        phase: str | None = None,
     ) -> EpochVerdict:
         """Judge one epoch's per-step loss/skip series (device arrays already
         fetched by the trainer's per-epoch metrics read)."""
         losses = np.asarray(losses)
         skip_flags = np.asarray(skipped) > 0.5
-        spike_flags = self.detector.observe(losses, skip_flags)
+        spike_flags = self._detector_for(phase).observe(losses, skip_flags)
         bad = skip_flags | spike_flags
         n_skip, n_spike = int(skip_flags.sum()), int(spike_flags.sum())
         self.skipped_steps += n_skip
@@ -126,6 +153,7 @@ class Watchdog:
                 "spike", epoch,
                 steps=np.flatnonzero(spike_flags)[:16].tolist(), count=n_spike,
                 losses=[round(float(x), 4) for x in losses[spike_flags][:16]],
+                **({"phase": phase} if phase is not None else {}),
             )
         rollback = max_bad >= self.cfg.bad_steps
         reason = None

@@ -18,7 +18,19 @@ of attempt 3".  ``obs`` is the one layer they all now report through:
   shows compute, staging, and checkpointing overlapping in time.  During
   a ``--profile-dir`` capture the same spans also emit
   ``jax.profiler.TraceAnnotation``s, so the xplane's device timeline
-  carries the host span names.
+  carries the host span names;
+- ``metrics.py`` — **per-step metrics with a sampling budget**: typed
+  counter/gauge/log-bucket-histogram accumulators the trainer records
+  into every step, flushed as bounded periodic ``metrics`` bus events
+  whose sketches merge associatively across flushes, hosts, and attempts;
+- ``blackbox.py`` — the **SIGKILL-surviving flight recorder**: an mmap'd
+  fixed-slot ring file per process mirroring every emit
+  (torn-page-tolerant decode), pulled by the supervisor after every
+  attempt into one cross-host ``blackbox.json`` under the ckpt root;
+- ``xplane.py`` — a dependency-free reader for the jax profiler's
+  ``*.xplane.pb`` captures, used by ``run_report --xplane`` to merge host
+  spans and the device trace into ONE Perfetto file joined on the
+  ``StepTraceAnnotation`` step ids.
 
 The process holds ONE current bus and ONE current span recorder
 (``configure`` installs them; ``emit``/``span`` reach them from any
@@ -34,6 +46,14 @@ hosts into one timeline + summary and validates captures (``--check``).
 
 from __future__ import annotations
 
+from .blackbox import (
+    BLACKBOX_NAME,
+    MmapRing,
+    collect_black_box,
+    decode_ring,
+    find_rings,
+    ring_filename,
+)
 from .bus import (
     ATTEMPT_ENV,
     CRASH_DUMP_NAME,
@@ -51,6 +71,17 @@ from .bus import (
     reset,
     validate_event,
 )
+from .metrics import (
+    METRICS_KIND,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    histogram_quantile,
+    histogram_summary,
+    merge_histograms,
+    merge_metric_events,
+)
 from .spans import (
     SpanRecorder,
     chrome_trace,
@@ -66,18 +97,33 @@ __all__ = [
     "SCHEMA_VERSION",
     "EVENTS_NAME",
     "CRASH_DUMP_NAME",
+    "BLACKBOX_NAME",
+    "METRICS_KIND",
     "RUN_ID_ENV",
     "ATTEMPT_ENV",
     "EventBus",
+    "MmapRing",
+    "collect_black_box",
     "configure",
     "crash_dump_filename",
     "current_bus",
+    "decode_ring",
     "emit",
     "events_filename",
+    "find_rings",
     "load_events",
     "new_run_id",
     "reset",
+    "ring_filename",
     "validate_event",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "histogram_quantile",
+    "histogram_summary",
+    "merge_histograms",
+    "merge_metric_events",
     "SpanRecorder",
     "chrome_trace",
     "current_recorder",

@@ -1,0 +1,208 @@
+"""The SIGKILL-surviving flight recorder and the cross-host black box.
+
+``crash_dump.json`` (bus.py) is written by the dying process — which means
+SIGKILL, the OOM killer, and a hard power-off of the attempt leave nothing:
+the in-memory ring dies with the process.  This module makes the ring
+durable the way aircraft do it:
+
+- ``MmapRing`` backs the bus's flight recorder with an **mmap'd
+  fixed-slot file** per process.  Every emit is also copied into the next
+  slot (sequence number + length + CRC32 header, payload truncated to the
+  slot); there is no flush — the pages are dirty in the OS page cache,
+  and the page cache survives the *process* dying by any signal
+  whatsoever (only losing the whole machine loses it).  Cost per event:
+  one memoryview copy, no syscall.
+- ``decode_ring`` reads a ring back **torn-page-tolerantly**: a slot whose
+  CRC does not match its payload (the writer died mid-copy, or the file
+  tore at a page boundary) is dropped; every intact slot survives, and
+  events come back in sequence order.
+- ``collect_black_box`` is the supervisor's pull: after every attempt it
+  decodes every ``flight*.ring`` under the checkpoint root (all hosts
+  write into the shared root under multi-host, exactly like the event
+  files) and rewrites ONE ``blackbox.json`` — the cross-host black box a
+  post-mortem opens first, present even when no process lived to write
+  its crash dump.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import struct
+import time
+import zlib
+from pathlib import Path
+
+MAGIC = b"DTCRNG1\n"
+_FILE_HEADER = struct.Struct("<8sII")   # magic, slot_size, n_slots
+_SLOT_HEADER = struct.Struct("<QII")    # seq (1-based), length, crc32
+SLOT_SIZE_DEFAULT = 1024
+RING_NAME = "flight.ring"
+BLACKBOX_NAME = "blackbox.json"
+
+
+def ring_filename(attempt: int = 0, process_index: int = 0) -> str:
+    """Per-attempt/per-process ring name, following the crash-dump naming
+    so a relaunched attempt in the same version dir never recycles (and
+    therefore never overwrites) a dead attempt's ring."""
+    if attempt == 0 and process_index == 0:
+        return RING_NAME
+    if process_index == 0:
+        return f"flight-a{attempt}.ring"
+    return f"flight-a{attempt}-p{process_index}.ring"
+
+
+class MmapRing:
+    """A fixed-slot, memory-mapped event ring (single writer).
+
+    NOT thread-safe by itself — the ``EventBus`` appends under its own
+    emit lock.  ``close`` unmaps; the file stays behind on purpose (it is
+    the artifact).
+    """
+
+    def __init__(
+        self,
+        path: str | Path,
+        slots: int = 256,
+        slot_size: int = SLOT_SIZE_DEFAULT,
+    ) -> None:
+        self.path = Path(path)
+        self.slots = max(1, int(slots))
+        self.slot_size = max(_SLOT_HEADER.size + 16, int(slot_size))
+        # payload bytes one slot holds — writers that care (the bus) check
+        # it and swap an oversized event for a compact stub BEFORE append,
+        # because a blind mid-JSON truncation decodes as a torn slot
+        self.capacity = self.slot_size - _SLOT_HEADER.size
+        self.seq = 0
+        size = _FILE_HEADER.size + self.slots * self.slot_size
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        # always a fresh file: a ring names one attempt of one process
+        # (ring_filename), so there is never a previous writer to continue
+        with open(self.path, "wb") as f:
+            f.write(_FILE_HEADER.pack(MAGIC, self.slot_size, self.slots))
+            f.truncate(size)
+        self._file = open(self.path, "r+b")
+        self._mm = mmap.mmap(self._file.fileno(), size)
+
+    def append(self, line: str) -> None:
+        """Copy one serialized event into the next slot (payload truncated
+        to the slot's capacity; header written LAST so a torn copy fails
+        its CRC instead of decoding garbage)."""
+        payload = line.encode("utf-8", "replace")[
+            : self.slot_size - _SLOT_HEADER.size
+        ]
+        self.seq += 1
+        base = _FILE_HEADER.size + ((self.seq - 1) % self.slots) * self.slot_size
+        body = base + _SLOT_HEADER.size
+        self._mm[body : body + len(payload)] = payload
+        self._mm[base : base + _SLOT_HEADER.size] = _SLOT_HEADER.pack(
+            self.seq, len(payload), zlib.crc32(payload)
+        )
+
+    def close(self) -> None:
+        try:
+            self._mm.flush()
+            self._mm.close()
+            self._file.close()
+        except (OSError, ValueError):
+            pass
+
+
+def decode_ring(path: str | Path) -> tuple[list[dict], int]:
+    """Read a ring file back: ``(events, torn)`` where ``events`` is every
+    intact slot's JSON record in sequence order and ``torn`` counts slots
+    that held data but failed their CRC/length/JSON checks.  Never raises
+    on damage — a half-written ring is exactly the input this exists for.
+    """
+    path = Path(path)
+    try:
+        raw = path.read_bytes()
+    except OSError:
+        return [], 0
+    if len(raw) < _FILE_HEADER.size:
+        return [], 0
+    magic, slot_size, n_slots = _FILE_HEADER.unpack_from(raw, 0)
+    if magic != MAGIC or slot_size <= _SLOT_HEADER.size or n_slots <= 0:
+        return [], 0
+    records: list[tuple[int, dict]] = []
+    torn = 0
+    cap = slot_size - _SLOT_HEADER.size
+    for i in range(n_slots):
+        base = _FILE_HEADER.size + i * slot_size
+        if base + _SLOT_HEADER.size > len(raw):
+            break  # truncated file: the tail slots never existed
+        seq, length, crc = _SLOT_HEADER.unpack_from(raw, base)
+        if seq == 0 and length == 0:
+            continue  # never written
+        body = raw[base + _SLOT_HEADER.size : base + _SLOT_HEADER.size + cap]
+        if seq == 0 or length > cap or length > len(body):
+            torn += 1
+            continue
+        payload = body[:length]
+        if zlib.crc32(payload) != crc:
+            torn += 1
+            continue
+        try:
+            records.append((seq, json.loads(payload.decode("utf-8"))))
+        except ValueError:
+            torn += 1
+    records.sort(key=lambda r: r[0])
+    return [r for _, r in records], torn
+
+
+def find_rings(root: str | Path) -> list[Path]:
+    """Every flight ring under a checkpoint root (the root itself, the
+    version dirs) — one per attempt per process, all hosts' rings visible
+    because the ckpt root is the shared filesystem multi-host already
+    contractually requires."""
+    root = Path(root)
+    if root.is_file():
+        return [root]
+    return sorted(root.glob("flight*.ring")) + sorted(
+        root.glob("version-*/flight*.ring")
+    )
+
+
+def collect_black_box(
+    root: str | Path, out_path: str | Path | None = None
+) -> Path | None:
+    """Decode every ring under ``root`` into one ``blackbox.json`` at the
+    checkpoint root: per-ring decoded events + torn counts, plus one
+    merged wall-clock timeline across attempts and hosts.  Rewritten in
+    full on every call (rings are bounded, so this is cheap) — the
+    supervisor calls it after every ``attempt_end``, and ``run_report
+    --blackbox`` calls it on demand.  Returns the path, or None when
+    there are no rings or the write fails; never raises."""
+    root = Path(root)
+    rings = find_rings(root)
+    if not rings:
+        return None
+    out = Path(out_path) if out_path is not None else root / BLACKBOX_NAME
+    report: dict = {
+        "v": 1,
+        "generated_t_wall": time.time(),
+        "rings": {},
+    }
+    merged: list[dict] = []
+    for ring in rings:
+        events, torn = decode_ring(ring)
+        try:
+            rel = str(ring.relative_to(root))
+        except ValueError:
+            rel = str(ring)
+        report["rings"][rel] = {
+            "events": len(events),
+            "torn": torn,
+            "first_t_wall": events[0].get("t_wall") if events else None,
+            "last_t_wall": events[-1].get("t_wall") if events else None,
+            "last_kinds": [e.get("kind") for e in events[-8:]],
+        }
+        merged.extend(events)
+    merged.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)))
+    report["events"] = merged
+    try:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+    except OSError:
+        return None
+    return out

@@ -22,7 +22,9 @@ cross-host appends to one shared file interleave).  Every event also lands
 in a bounded in-memory ring — the **flight recorder** — which
 ``dump_crash`` writes to ``crash_dump.json`` on abort, watchdog budget
 exhaustion, or an unhandled exception, so post-mortems read the final ring
-instead of scraping log files.
+instead of scraping log files.  ``attach_ring`` additionally mirrors the
+ring into an mmap'd fixed-slot file (``blackbox.py``) that survives even
+SIGKILL — the deaths no in-process dump can catch.
 
 Writes are accounting: an ``OSError`` is swallowed (after disabling the
 sink) — telemetry must never kill training.
@@ -124,6 +126,7 @@ class EventBus:
         self._path: Path | None = None
         self._broken = False  # sink died (OSError); ring keeps recording
         self._crash_path: Path | None = None  # first dump wins
+        self._mmap_ring = None  # durable twin of the in-memory ring
 
     # -------------------------------------------------------------- emit
 
@@ -157,6 +160,11 @@ class EventBus:
         line = json.dumps(ev, default=_jsonable)
         with self._lock:
             self._ring.append(ev)
+            if self._mmap_ring is not None:
+                try:
+                    self._mmap_ring.append(self._ring_line(ev, line))
+                except (OSError, ValueError):
+                    self._mmap_ring = None  # durability lost, training isn't
             if self._file is not None:
                 self._write(line)
             elif self._persist and not self._broken:
@@ -209,6 +217,53 @@ class EventBus:
     def bound_path(self) -> Path | None:
         return self._path
 
+    def _ring_line(self, ev: dict, line: str) -> str:
+        """The serialization of ``ev`` that goes into a fixed-slot ring: the
+        full line when it fits, otherwise the envelope with the payload
+        replaced by a ``{"truncated": <bytes>}`` stub — a blindly cut JSON
+        line would decode as a TORN slot, losing the event's kind and
+        timing along with its bulk."""
+        cap = self._mmap_ring.capacity
+        if len(line.encode("utf-8", "replace")) <= cap:
+            return line
+        stub = {k: v for k, v in ev.items() if k != "payload"}
+        stub["payload"] = {"truncated": len(line)}
+        return json.dumps(stub, default=_jsonable)
+
+    def attach_ring(
+        self, path: str | Path, slots: int | None = None,
+        slot_size: int | None = None,
+    ) -> Path | None:
+        """Back the flight recorder with an mmap'd fixed-slot file at
+        ``path`` (blackbox.py): from here on every emit is also copied
+        into the ring's next slot, and the file survives the process
+        dying by ANY signal — including the SIGKILL/OOM deaths
+        ``dump_crash`` can never catch.  The in-memory ring that was
+        recorded before the attach seeds the file, so pre-bind events are
+        not lost to the black box.  Never raises; returns the path or
+        None when the ring could not be created."""
+        from .blackbox import SLOT_SIZE_DEFAULT, MmapRing
+
+        with self._lock:
+            prev = self._mmap_ring
+            try:
+                ring = MmapRing(
+                    path,
+                    slots=slots or self._ring.maxlen,
+                    slot_size=slot_size or SLOT_SIZE_DEFAULT,
+                )
+                self._mmap_ring = ring  # _ring_line reads its capacity
+                for ev in self._ring:
+                    ring.append(
+                        self._ring_line(ev, json.dumps(ev, default=_jsonable))
+                    )
+            except (OSError, ValueError):
+                self._mmap_ring = prev  # a failed attach keeps the old ring
+                return None
+            if prev is not None:
+                prev.close()
+        return ring.path
+
     def close(self) -> None:
         with self._lock:
             if self._file is not None:
@@ -217,6 +272,9 @@ class EventBus:
                 except OSError:
                     pass
                 self._file = None
+            if self._mmap_ring is not None:
+                self._mmap_ring.close()
+                self._mmap_ring = None
 
     # --------------------------------------------------- flight recorder
 

@@ -1,0 +1,371 @@
+"""Typed per-step metrics with a sampling budget for the event bus.
+
+The bus (``bus.py``) is a lock + append per emit — fine for epoch- and
+chunk-granular events, ruinous at per-step rates (a 10k-step epoch would
+pay 10k lock/json/write cycles and grow ``events.jsonl`` unboundedly).
+This module closes that gap with the classic telemetry split:
+
+- **record** is cheap and unbounded: the trainer records ``grad_norm``,
+  per-step loss, and the ``StepTimeMeter`` phase durations *every step*
+  into typed accumulators (counter / gauge / fixed-log-bucket histogram);
+  a record is one lock + one dict bump, no I/O, no JSON;
+- **flush** is bounded and periodic: every ``--metrics-flush-steps``
+  steps (and at every epoch end) the registry snapshots all accumulators
+  into ONE ``metrics`` bus event and resets them, so the bus sees a
+  bounded number of events regardless of step count.
+
+Histograms are **sketches**: fixed logarithmic buckets (``BPD`` buckets
+per decade of value), stored sparsely.  Two sketches merge by adding
+bucket counts — an associative, commutative fold — so per-flush deltas
+recombine exactly across flushes, hosts, and attempts, and
+``tools/run_report.py`` can reconstruct p50/p95/p99 for any slice of the
+run from the event stream alone (quantile error is bounded by the bucket
+ratio, ~±7.5%% at the default 16 buckets/decade).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+# histogram resolution: buckets per decade of value.  16/decade makes
+# adjacent bucket bounds differ by 10^(1/16) ~= 1.155 — quantiles read
+# back from the sketch land within ~±7.5% of the exact sample quantile.
+BPD_DEFAULT = 16
+# bucket index clamp: [-8, +8] decades covers 1e-8 .. 1e8 — beyond it the
+# extreme buckets absorb the tails (min/max still record exactly)
+_DECADE_CLAMP = 8
+
+METRICS_KIND = "metrics"  # the bus event kind every flush emits
+
+
+class Counter:
+    """A monotonically increasing count (events, bytes, retries)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._n += int(n)
+
+    def snapshot(self, reset: bool = True) -> dict | None:
+        with self._lock:
+            n, dirty = self._n, self._n != 0
+            if reset:
+                self._n = 0
+        if not dirty:
+            return None
+        return {"type": "counter", "n": n}
+
+
+class Gauge:
+    """A last-write-wins instantaneous value (queue depth, staged chunks)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._value: float | None = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def snapshot(self, reset: bool = True) -> dict | None:
+        # gauges are NOT reset on flush: the queue is still that deep after
+        # the snapshot — but an unset gauge stays out of the event
+        with self._lock:
+            v = self._value
+        if v is None:
+            return None
+        return {"type": "gauge", "value": v}
+
+
+class Histogram:
+    """A fixed-log-bucket distribution sketch with associative merge.
+
+    ``record`` costs one log + one dict bump; non-positive and non-finite
+    samples land in dedicated side counts (a grad norm of 0.0 or an inf
+    from a skipped step must not poison the log buckets).  ``merge`` adds
+    bucket counts — order-independent by construction, the property that
+    lets per-flush deltas recombine across flushes, hosts, and attempts.
+    """
+
+    def __init__(self, name: str, bpd: int = BPD_DEFAULT) -> None:
+        self.name = name
+        self.bpd = int(bpd)
+        self._lock = threading.Lock()
+        self._buckets: dict[int, int] = {}
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._zeros = 0      # samples <= 0 (no log bucket exists for them)
+        self._nonfinite = 0  # nan/inf samples
+
+    def _index(self, value: float) -> int:
+        idx = math.floor(math.log10(value) * self.bpd)
+        lo, hi = -_DECADE_CLAMP * self.bpd, _DECADE_CLAMP * self.bpd
+        return min(max(idx, lo), hi)
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            if not math.isfinite(value):
+                self._nonfinite += 1
+                return
+            self._count += 1
+            self._sum += value
+            self._min = min(self._min, value)
+            self._max = max(self._max, value)
+            if value <= 0.0:
+                self._zeros += 1
+                return
+            idx = self._index(value)
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    def record_many(self, values) -> None:
+        """Vectorized ``record`` for the trainer's stacked per-step arrays
+        (one numpy pass instead of a Python loop per step)."""
+        arr = np.asarray(values, np.float64).ravel()
+        if arr.size == 0:
+            return
+        finite = np.isfinite(arr)
+        pos = finite & (arr > 0.0)
+        idx = np.empty(0, np.int64)
+        if pos.any():
+            idx = np.floor(np.log10(arr[pos]) * self.bpd).astype(np.int64)
+            np.clip(
+                idx, -_DECADE_CLAMP * self.bpd, _DECADE_CLAMP * self.bpd,
+                out=idx,
+            )
+        vals = arr[finite]
+        with self._lock:
+            self._nonfinite += int(arr.size - finite.sum())
+            if vals.size:
+                self._count += int(vals.size)
+                self._sum += float(vals.sum())
+                self._min = min(self._min, float(vals.min()))
+                self._max = max(self._max, float(vals.max()))
+                self._zeros += int(vals.size) - int(pos.sum())
+            for i, n in zip(*np.unique(idx, return_counts=True)):
+                self._buckets[int(i)] = self._buckets.get(int(i), 0) + int(n)
+
+    def snapshot(self, reset: bool = True) -> dict | None:
+        with self._lock:
+            if self._count == 0 and self._nonfinite == 0:
+                return None
+            out = {
+                "type": "histogram",
+                "bpd": self.bpd,
+                "count": self._count,
+                "sum": round(self._sum, 6),
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+                "zeros": self._zeros,
+                "nonfinite": self._nonfinite,
+                # JSON objects key on strings; decode side int()s them back
+                "buckets": {str(k): v for k, v in self._buckets.items()},
+            }
+            if reset:
+                self._buckets = {}
+                self._count = 0
+                self._sum = 0.0
+                self._min = math.inf
+                self._max = -math.inf
+                self._zeros = 0
+                self._nonfinite = 0
+        return out
+
+
+# --------------------------------------------------- sketch-dict operations
+#
+# Flush events carry histogram snapshots as plain dicts; everything a
+# report needs (merge across flushes/hosts/attempts, quantiles) operates
+# on that dict shape so run_report never has to reconstruct objects.
+
+
+def merge_histograms(a: dict | None, b: dict | None) -> dict | None:
+    """Associative, commutative merge of two histogram snapshot dicts."""
+    if not a:
+        return dict(b) if b else None
+    if not b:
+        return dict(a)
+    if a.get("bpd") != b.get("bpd"):
+        # differently-binned sketches cannot merge losslessly; keep the
+        # bigger sample rather than fabricating buckets
+        return dict(a) if a.get("count", 0) >= b.get("count", 0) else dict(b)
+    buckets = dict(a.get("buckets") or {})
+    for k, v in (b.get("buckets") or {}).items():
+        buckets[k] = buckets.get(k, 0) + v
+    mins = [x["min"] for x in (a, b) if x.get("min") is not None]
+    maxs = [x["max"] for x in (a, b) if x.get("max") is not None]
+    return {
+        "type": "histogram",
+        "bpd": a.get("bpd", BPD_DEFAULT),
+        "count": a.get("count", 0) + b.get("count", 0),
+        "sum": round(a.get("sum", 0.0) + b.get("sum", 0.0), 6),
+        "min": min(mins) if mins else None,
+        "max": max(maxs) if maxs else None,
+        "zeros": a.get("zeros", 0) + b.get("zeros", 0),
+        "nonfinite": a.get("nonfinite", 0) + b.get("nonfinite", 0),
+        "buckets": buckets,
+    }
+
+
+def histogram_quantile(hist: dict | None, q: float) -> float | None:
+    """Approximate quantile from a histogram snapshot dict (``q`` in
+    [0, 1]).  Bucketed samples resolve to the bucket's geometric midpoint
+    (error bounded by the bucket ratio); zero/negative samples sit below
+    every bucket; the recorded exact min/max clamp the extremes."""
+    if not hist or not hist.get("count"):
+        return None
+    bpd = hist.get("bpd", BPD_DEFAULT)
+    total = hist["count"]
+    rank = q * (total - 1) + 1  # 1-based rank of the target sample
+    seen = hist.get("zeros", 0)
+    if rank <= seen:
+        return float(hist.get("min", 0.0) or 0.0)
+    value = None
+    for k in sorted((hist.get("buckets") or {}), key=int):
+        seen += hist["buckets"][k]
+        if rank <= seen:
+            value = 10.0 ** ((int(k) + 0.5) / bpd)
+            break
+    if value is None:
+        value = hist.get("max")
+    if value is None:
+        return None
+    if hist.get("min") is not None:
+        value = max(value, float(hist["min"]))
+    if hist.get("max") is not None:
+        value = min(value, float(hist["max"]))
+    return float(value)
+
+
+def histogram_summary(hist: dict | None) -> dict | None:
+    """p50/p95/p99/mean/max for report tables, straight off a sketch."""
+    if not hist or not hist.get("count"):
+        return None
+    return {
+        "count": hist["count"],
+        "mean": round(hist.get("sum", 0.0) / hist["count"], 6),
+        "p50": round(histogram_quantile(hist, 0.50), 6),
+        "p95": round(histogram_quantile(hist, 0.95), 6),
+        "p99": round(histogram_quantile(hist, 0.99), 6),
+        "max": hist.get("max"),
+    }
+
+
+def merge_metric_events(events) -> dict:
+    """Fold the ``metrics`` payloads of many flush events into one
+    name → snapshot dict: histograms merge associatively, counters sum,
+    gauges keep the latest (events are assumed time-ordered).  Accepts
+    full bus events or bare payload dicts."""
+    out: dict[str, dict] = {}
+    for ev in events:
+        payload = ev.get("payload", ev) if isinstance(ev, dict) else {}
+        for name, snap in (payload.get("metrics") or {}).items():
+            if not isinstance(snap, dict):
+                continue
+            prev = out.get(name)
+            if snap.get("type") == "histogram":
+                out[name] = merge_histograms(prev, snap)
+            elif snap.get("type") == "counter":
+                n = (prev or {}).get("n", 0) + snap.get("n", 0)
+                out[name] = {"type": "counter", "n": n}
+            else:
+                out[name] = dict(snap)
+    return out
+
+
+# ----------------------------------------------------------------- registry
+
+
+class MetricRegistry:
+    """One process's named metrics + the flush budget.
+
+    ``counter``/``gauge``/``histogram`` create-or-return by name (the hot
+    path holds the instance, not the name — lookup is setup cost, not
+    per-step cost).  ``flush`` snapshots every non-empty metric into ONE
+    ``metrics`` event on the given bus and resets the deltas;
+    ``maybe_flush`` applies the step budget: it only flushes once
+    ``flush_steps`` steps have accumulated since the last flush, so a
+    caller can invoke it at every chunk boundary and the bus still sees
+    a bounded, periodic stream.
+    """
+
+    def __init__(self, flush_steps: int = 50) -> None:
+        self.flush_steps = max(1, int(flush_steps))
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._steps_since_flush = 0
+        self.flushes = 0
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def note_steps(self, n: int = 1) -> None:
+        """Account ``n`` trained steps against the flush budget."""
+        with self._lock:
+            self._steps_since_flush += int(n)
+
+    def snapshot(self, reset: bool = True) -> dict:
+        """Name → snapshot dict of every metric with data since the last
+        flush (empty metrics are omitted — a flush event never carries
+        dead weight)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in metrics:
+            snap = m.snapshot(reset=reset)
+            if snap is not None:
+                out[m.name] = snap
+        return out
+
+    def flush(self, bus, *, epoch: int | None = None, step: int | None = None):
+        """Emit one ``metrics`` event with every pending snapshot; returns
+        the event, or None when nothing was recorded since the last flush."""
+        with self._lock:
+            steps = self._steps_since_flush
+            self._steps_since_flush = 0
+        snaps = self.snapshot(reset=True)
+        if not snaps:
+            return None
+        self.flushes += 1
+        return bus.emit(
+            METRICS_KIND, epoch=epoch, step=step,
+            metrics=snaps, steps=steps,
+        )
+
+    def maybe_flush(
+        self, bus, *, epoch: int | None = None, step: int | None = None
+    ):
+        """``flush`` only if the per-step budget has accumulated — the
+        call every chunk boundary makes; cost when not due: one lock."""
+        with self._lock:
+            if self._steps_since_flush < self.flush_steps:
+                return None
+        return self.flush(bus, epoch=epoch, step=step)

@@ -1,0 +1,343 @@
+"""Merge host spans and a jax profiler capture into ONE Perfetto file.
+
+A ``--profile-dir`` capture and the host span trace describe the same
+seconds of the same run, but land in different files on different clocks:
+the spans (``spans.py``) are Chrome-trace JSON on ``time.monotonic``; the
+profiler writes an **XSpace protobuf** (``*.xplane.pb``) whose lines run
+on the profiler session clock.  Reading the xplane normally requires the
+tensorflow profiler plugin — a dependency this repo does not carry — so
+this module parses the protobuf *wire format* directly: XSpace is four
+nested message types with stable field numbers, which a ~50-line varint
+walker decodes on any Python.
+
+The join key is the ``StepTraceAnnotation("train", step_num=...)`` the
+trainer plants around every chunk dispatch (PR 5): the same step ids
+appear as ``train`` events in the xplane's host plane and as ``step``
+args on the host ``dispatch`` spans.  Matching them gives the clock
+offset between the two captures; shifting the xplane events by it puts
+device lanes and host lanes on one time axis, in one file Perfetto opens
+directly — "what was the host doing while the device ran step N" becomes
+one screen instead of two files and a mental diff.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+from pathlib import Path
+
+# XSpace wire schema (tensorflow/compiler/xla/tsl/profiler/protobuf/xplane.proto)
+# — field numbers only, which is all the wire format needs:
+#   XSpace:  planes=1
+#   XPlane:  id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+#   XLine:   id=1 name=2 timestamp_ns=3 events=4 display_name=11
+#   XEvent:  metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+#   XStat:   metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7
+#   X*Metadata: id=1 name=2
+
+
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield ``(field_number, wire_type, value)`` triples of one message."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fnum, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, i = _varint(buf, i)
+        elif wt == 1:
+            val, i = buf[i : i + 8], i + 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            val, i = buf[i : i + ln], i + ln
+        elif wt == 5:
+            val, i = buf[i : i + 4], i + 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fnum, wt, val
+
+
+def _group(buf: bytes) -> dict[int, list]:
+    out: dict[int, list] = {}
+    for fnum, _, val in _fields(buf):
+        out.setdefault(fnum, []).append(val)
+    return out
+
+
+def _metadata_map(entries: list[bytes]) -> dict[int, str]:
+    """map<int64, X*Metadata> → id → name."""
+    out: dict[int, str] = {}
+    for entry in entries:
+        e = _group(entry)
+        for msg in e.get(2, ()):
+            m = _group(msg)
+            mid = m.get(1, [0])[0]
+            name = m.get(2, [b""])[0]
+            out[int(mid)] = name.decode("utf-8", "replace")
+    return out
+
+
+def _stat_value(stat: dict[int, list], stat_names: dict[int, str]):
+    for fnum in (4, 3):  # int64, uint64 (varint)
+        if fnum in stat:
+            return stat[fnum][0]
+    if 2 in stat:  # double, fixed64
+        import struct
+
+        return struct.unpack("<d", stat[2][0])[0]
+    for fnum in (5, 6):  # str, bytes
+        if fnum in stat:
+            return stat[fnum][0].decode("utf-8", "replace")
+    if 7 in stat:  # ref into stat_metadata
+        return stat_names.get(int(stat[7][0]), stat[7][0])
+    return None
+
+
+def parse_xplane(path: str | Path) -> list[dict]:
+    """An ``.xplane.pb`` file → plane dicts::
+
+        {"name": str, "lines": [{"name": str, "timestamp_ns": int,
+          "events": [{"name": str, "ts_us": float, "dur_us": float,
+                      "stats": {...}}]}]}
+    """
+    data = Path(path).read_bytes()
+    planes = []
+    for fnum, _, val in _fields(data):
+        if fnum != 1:
+            continue
+        p = _group(val)
+        event_names = _metadata_map(p.get(4, []))
+        stat_names = _metadata_map(p.get(5, []))
+        lines = []
+        for raw_line in p.get(3, []):
+            ln = _group(raw_line)
+            ts_ns = int(ln.get(3, [0])[0])
+            events = []
+            for raw_ev in ln.get(4, []):
+                ev = _group(raw_ev)
+                stats = {}
+                for raw_stat in ev.get(4, []):
+                    st = _group(raw_stat)
+                    key = stat_names.get(int(st.get(1, [0])[0]))
+                    if key:
+                        stats[key] = _stat_value(st, stat_names)
+                events.append(
+                    {
+                        "name": event_names.get(
+                            int(ev.get(1, [0])[0]), "?"
+                        ),
+                        "ts_us": ts_ns / 1e3 + int(ev.get(2, [0])[0]) / 1e6,
+                        "dur_us": int(ev.get(3, [0])[0]) / 1e6,
+                        "stats": stats,
+                    }
+                )
+            lines.append(
+                {
+                    "name": ln.get(2, [b""])[0].decode("utf-8", "replace"),
+                    "timestamp_ns": ts_ns,
+                    "events": events,
+                }
+            )
+        planes.append(
+            {"name": p.get(2, [b""])[0].decode("utf-8", "replace"), "lines": lines}
+        )
+    return planes
+
+
+def find_xplanes(profile_dir: str | Path) -> list[Path]:
+    """Every ``*.xplane.pb`` under a ``--profile-dir`` capture (the
+    profiler nests them under ``plugins/profile/<timestamp>/``)."""
+    return sorted(Path(profile_dir).rglob("*.xplane.pb"))
+
+
+def find_profiler_traces(profile_dir: str | Path) -> list[Path]:
+    """Fallback artifacts: the ``*.trace.json(.gz)`` files some jax
+    versions write next to the xplane."""
+    root = Path(profile_dir)
+    return sorted(root.rglob("*.trace.json.gz")) + sorted(
+        root.rglob("*.trace.json")
+    )
+
+
+# ------------------------------------------------------------- chrome shape
+
+
+def planes_to_chrome(
+    planes: list[dict], pid_base: int = 1000, name_filter=None
+) -> list[dict]:
+    """XSpace planes → Chrome-trace events (``ph: X`` + lane metadata).
+    ``name_filter`` drops noise lanes (the host plane records every Python
+    frame during a capture — tens of thousands of events nobody asked
+    for); it receives an event name and returns True to keep."""
+    out: list[dict] = []
+    for pi, plane in enumerate(planes):
+        pid = pid_base + pi
+        out.append(
+            {
+                "ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": f"xplane {plane['name']}"},
+            }
+        )
+        for ti, line in enumerate(plane["lines"]):
+            out.append(
+                {
+                    "ph": "M", "name": "thread_name", "pid": pid, "tid": ti,
+                    "args": {"name": line["name"] or f"line-{ti}"},
+                }
+            )
+            for ev in line["events"]:
+                if name_filter is not None and not name_filter(ev["name"]):
+                    continue
+                rec = {
+                    "ph": "X",
+                    "name": ev["name"],
+                    "pid": pid,
+                    "tid": ti,
+                    "ts": round(ev["ts_us"], 3),
+                    "dur": round(ev["dur_us"], 3),
+                }
+                if ev["stats"]:
+                    rec["args"] = {
+                        k: v for k, v in ev["stats"].items()
+                        if not str(k).startswith("_")
+                    }
+                out.append(rec)
+    return out
+
+
+def default_name_filter(name: str) -> bool:
+    """Keep annotation/step/XLA events, drop the Python-frame firehose
+    (``$module.py:123 fn`` names) the host plane records during capture."""
+    return not name.startswith("$")
+
+
+def step_marks(chrome_events: list[dict], name: str = "train") -> dict[int, float]:
+    """step_num → begin-ts(us) of the ``StepTraceAnnotation`` events in a
+    Chrome event list (xplane- or profiler-trace-derived; ``step_num``
+    arrives as an int stat or a string arg depending on the writer)."""
+    marks: dict[int, float] = {}
+    for ev in chrome_events:
+        if ev.get("ph") != "X" or ev.get("name") != name:
+            continue
+        step = (ev.get("args") or {}).get("step_num")
+        try:
+            step = int(step)
+        except (TypeError, ValueError):
+            continue
+        # first occurrence wins: one annotation per chunk dispatch
+        marks.setdefault(step, float(ev["ts"]))
+    return marks
+
+
+def host_span_step_marks(trace: dict) -> dict[int, float]:
+    """step → begin-ts(us) of the host ``dispatch`` spans that carry a
+    ``step`` arg (utils/meters.py records one per chunk dispatch)."""
+    marks: dict[int, float] = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X" or ev.get("name") != "dispatch":
+            continue
+        step = (ev.get("args") or {}).get("step")
+        try:
+            step = int(step)
+        except (TypeError, ValueError):
+            continue
+        marks.setdefault(step, float(ev["ts"]))
+    return marks
+
+
+def _median(vals: list[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def load_profiler_chrome_events(profile_dir: str | Path) -> list[dict]:
+    """All device/host profiler events under a capture dir as Chrome
+    events: xplane protobufs when present, the profiler's own trace.json
+    artifacts otherwise."""
+    events: list[dict] = []
+    for i, pb in enumerate(find_xplanes(profile_dir)):
+        planes = parse_xplane(pb)
+        events.extend(
+            planes_to_chrome(
+                planes, pid_base=1000 + 100 * i, name_filter=default_name_filter
+            )
+        )
+    if events:
+        return events
+    for i, tr in enumerate(find_profiler_traces(profile_dir)):
+        opener = gzip.open if tr.suffix == ".gz" else open
+        try:
+            with opener(tr, "rt") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "X" and not default_name_filter(
+                str(ev.get("name", ""))
+            ):
+                continue
+            ev = dict(ev, pid=2000 + 100 * i + int(ev.get("pid", 0)) % 100)
+            events.append(ev)
+    return events
+
+
+def merge_host_and_xplane(
+    host_traces: list[dict], profiler_events: list[dict]
+) -> tuple[dict, dict]:
+    """One Perfetto document from host span traces + profiler events,
+    joined on step ids.  Returns ``(document, info)`` where ``info``
+    records how the clocks were aligned (``matched_steps``, ``offset_us``,
+    ``aligned``) — a merge that found no shared step ids still emits both
+    lanes, aligned on first-event time, and says so."""
+    merged: list[dict] = []
+    host_marks: dict[int, float] = {}
+    for trace in host_traces:
+        merged.extend(trace.get("traceEvents", []))
+        for step, ts in host_span_step_marks(trace).items():
+            host_marks.setdefault(step, ts)
+    prof_marks = step_marks(profiler_events)
+    shared = sorted(set(host_marks) & set(prof_marks))
+    if shared:
+        offset = _median([host_marks[s] - prof_marks[s] for s in shared])
+        aligned = "step_ids"
+    else:
+        # no shared step annotations (e.g. a capture without the trainer's
+        # StepTraceAnnotations): pin both first events to the same instant
+        host_ts = [
+            e["ts"] for e in merged if e.get("ph") == "X"
+        ]
+        prof_ts = [
+            e["ts"] for e in profiler_events if e.get("ph") == "X"
+        ]
+        offset = (
+            (min(host_ts) - min(prof_ts)) if host_ts and prof_ts else 0.0
+        )
+        aligned = "first_event"
+    for ev in profiler_events:
+        ev = dict(ev)
+        if "ts" in ev:
+            ev["ts"] = round(float(ev["ts"]) + offset, 3)
+        merged.append(ev)
+    doc = {"traceEvents": merged, "displayTimeUnit": "ms"}
+    info = {
+        "host_traces": len(host_traces),
+        "profiler_events": sum(
+            1 for e in profiler_events if e.get("ph") == "X"
+        ),
+        "matched_steps": len(shared),
+        "offset_us": round(offset, 3),
+        "aligned": aligned,
+    }
+    return doc, info

@@ -268,13 +268,22 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
 
         return resume_progress_marker(hparams.ckpt_path)
 
+    def on_event(kind: str, **payload):
+        bus.emit(kind, **payload)
+        if kind == "attempt_end" and obs_enabled:
+            # the black-box pull: decode every host's mmap flight ring
+            # under the ckpt root (version dirs included) into ONE
+            # blackbox.json — present even when the attempt died by
+            # SIGKILL/OOM and no process lived to write its crash dump
+            obs.collect_black_box(hparams.ckpt_path)
+
     sup = Supervisor(
         cmd_for,
         env=env_for,
         max_restarts=getattr(hparams, "max_restarts", 3),
         backoff_base=getattr(hparams, "restart_backoff", 1.0),
         progress=progress_probe,
-        events=lambda kind, **payload: bus.emit(kind, **payload),
+        events=on_event,
     )
     t_start = time.time()
     summary = sup.run()

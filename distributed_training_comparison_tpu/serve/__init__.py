@@ -156,7 +156,16 @@ def serve_main(hparams) -> dict:
         image_size=engine.image_size,
         seed=hparams.seed,
     )
-    metrics = ServeMetrics()
+    # bind the run-event bus up front so the periodic `metrics` events the
+    # session emits (latency-histogram deltas + queue gauges — the live SLO
+    # feed `run_report --follow` tails) land in the ckpt root's events.jsonl
+    from .. import obs
+
+    bus = None
+    if getattr(hparams, "obs", True):
+        bus = obs.current_bus()
+        bus.bind_dir(hparams.ckpt_path)
+    metrics = ServeMetrics(bus=bus)
     deadline = getattr(hparams, "deadline_ms", 0.0) or None
     with MicroBatcher(
         engine,
@@ -189,9 +198,5 @@ def serve_main(hparams) -> dict:
         # one summary record on the unified run-event bus: a serving
         # session's artifacts join training's on the same timeline schema
         # (ckpt-root events.jsonl, next to the supervisor's)
-        from .. import obs
-
-        if getattr(hparams, "obs", True):
-            obs.current_bus().bind_dir(hparams.ckpt_path)
-        metrics.emit_event(obs.current_bus())
+        metrics.emit_event(bus if bus is not None else obs.current_bus())
     return report

@@ -11,15 +11,34 @@ All recording methods are called from the micro-batcher's worker thread
 and the load generators' submitter threads concurrently; a single lock
 guards the counters (the hot path appends one float per request — the
 lock is not a bottleneck at the request rates one host can offer).
+
+Memory contract: raw samples are **reservoir-sampled** past
+``RESERVOIR_CAP`` (Vitter's algorithm R) — a millions-of-requests run
+keeps a fixed-size uniform sample instead of growing host RAM without
+bound.  Percentiles come off the reservoir (an unbiased estimate);
+counts, means, and maxima stay EXACT via running accumulators.  Every
+latency additionally lands in a log-bucket histogram sketch
+(``obs/metrics.py``), and ``maybe_emit_metrics`` flushes it as periodic
+``metrics`` events on the run-event bus — the live SLO timeline
+``tools/run_report.py --follow`` tails.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from pathlib import Path
 
 import numpy as np
+
+from ..obs.metrics import Histogram, histogram_summary
+
+# past this many samples per series, switch to reservoir sampling; 8192
+# keeps p99 of a uniform sample within ~±1.5% rank error
+RESERVOIR_CAP = 8192
+# default seconds between periodic `metrics` bus events (live SLO feed)
+EMIT_EVERY_S_DEFAULT = 5.0
 
 
 def latency_summary_ms(latencies_s) -> dict[str, float]:
@@ -37,30 +56,94 @@ def latency_summary_ms(latencies_s) -> dict[str, float]:
     }
 
 
-class ServeMetrics:
-    """Counters + samples for one serving session."""
+class _Reservoir:
+    """Algorithm-R uniform reservoir + exact running count/sum/max.
 
-    def __init__(self) -> None:
+    NOT thread-safe — callers hold the ``ServeMetrics`` lock.  Seeded RNG:
+    two runs over the same request stream keep the same sample (capture
+    diffs stay meaningful).
+    """
+
+    def __init__(self, cap: int = RESERVOIR_CAP, seed: int = 0) -> None:
+        self.cap = int(cap)
+        self.values: list[float] = []
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self.last = 0.0  # exact latest sample (the reservoir loses order)
+        self._rng = random.Random(seed)
+
+    def add(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.last = value
+        if value > self.max:
+            self.max = value
+        if len(self.values) < self.cap:
+            self.values.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.cap:
+                self.values[j] = value
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+
+class ServeMetrics:
+    """Counters + bounded samples for one serving session.
+
+    ``bus`` (optional): a run-event bus to receive periodic ``metrics``
+    events with the latency/batch histograms — rate-limited to one event
+    per ``emit_every_s``, so a flood of requests cannot flood the bus.
+    """
+
+    def __init__(
+        self, bus=None, emit_every_s: float = EMIT_EVERY_S_DEFAULT
+    ) -> None:
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
-        self.latencies_s: list[float] = []
-        self.batch_sizes: list[int] = []
-        self.queue_depths: list[int] = []
+        self._latencies = _Reservoir()
+        self._batch_sizes = _Reservoir()
+        self._queue_depths = _Reservoir()
         self.completed = 0
         self.shed = 0
         self.expired = 0
         self.errors = 0
+        self.bus = bus
+        self.emit_every_s = float(emit_every_s)
+        self._last_emit = self._t0
+        # the associatively-mergeable sketch the bus events carry; the
+        # reservoir serves the exact-ish in-process summary() instead
+        self._latency_hist = Histogram("serve/latency_s")
+
+    # back-compat views: callers/tests read the raw sample lists by name
+    @property
+    def latencies_s(self) -> list[float]:
+        return self._latencies.values
+
+    @property
+    def batch_sizes(self) -> list[float]:
+        return self._batch_sizes.values
+
+    @property
+    def queue_depths(self) -> list[float]:
+        return self._queue_depths.values
 
     # ------------------------------------------------------------ record
     def record_request_done(self, latency_s: float) -> None:
         with self._lock:
             self.completed += 1
-            self.latencies_s.append(float(latency_s))
+            self._latencies.add(latency_s)
+        self._latency_hist.record(latency_s)
+        self._maybe_emit_metrics()
 
     def record_batch(self, batch_size: int, queue_depth: int) -> None:
         with self._lock:
-            self.batch_sizes.append(int(batch_size))
-            self.queue_depths.append(int(queue_depth))
+            self._batch_sizes.add(int(batch_size))
+            self._queue_depths.add(int(queue_depth))
 
     def record_shed(self) -> None:
         with self._lock:
@@ -75,13 +158,46 @@ class ServeMetrics:
             self.errors += 1
 
     # ----------------------------------------------------------- report
+    def _maybe_emit_metrics(self) -> None:
+        """One rate-limited ``metrics`` event on the bus: the latency
+        histogram delta since the last emit + instantaneous gauges — the
+        live SLO timeline (``run_report --follow``) without per-request
+        bus traffic."""
+        if self.bus is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_emit < self.emit_every_s:
+                return
+            self._last_emit = now
+            completed, shed, expired = self.completed, self.shed, self.expired
+            # .last, not values[-1]: once the reservoir caps, the list's
+            # tail is an arbitrary historical sample, not the newest depth
+            depth = self._queue_depths.last
+        snap = self._latency_hist.snapshot(reset=True)
+        if snap is None:
+            return
+        self.bus.emit(
+            "metrics",
+            metrics={
+                "serve/latency_s": snap,
+                "serve/queue_depth": {"type": "gauge", "value": depth},
+                "serve/completed": {"type": "gauge", "value": completed},
+                "serve/shed": {"type": "gauge", "value": shed},
+                "serve/expired": {"type": "gauge", "value": expired},
+            },
+        )
+
     def summary(self) -> dict:
-        """One dict with everything a serving report needs."""
+        """One dict with everything a serving report needs.  Percentiles
+        are reservoir estimates once the sample caps; counts/means/maxima
+        are exact regardless of volume."""
         with self._lock:
             elapsed = max(time.monotonic() - self._t0, 1e-9)
-            lat = latency_summary_ms(self.latencies_s)
-            batches = np.asarray(self.batch_sizes, np.float64)
-            depths = np.asarray(self.queue_depths, np.float64)
+            lat = latency_summary_ms(self._latencies.values)
+            # the reservoir's percentile estimate, but the EXACT moments
+            lat["mean"] = round(self._latencies.mean * 1e3, 3)
+            lat["max"] = round(self._latencies.max * 1e3, 3)
             return {
                 "completed": self.completed,
                 "shed": self.shed,
@@ -90,16 +206,13 @@ class ServeMetrics:
                 "duration_s": round(elapsed, 3),
                 "throughput_rps": round(self.completed / elapsed, 2),
                 "latency_ms": lat,
-                "batches": len(self.batch_sizes),
-                "mean_batch_size": (
-                    round(float(batches.mean()), 2) if len(batches) else 0.0
+                "latency_sampled": self._latencies.count > len(
+                    self._latencies.values
                 ),
-                "mean_queue_depth": (
-                    round(float(depths.mean()), 2) if len(depths) else 0.0
-                ),
-                "max_queue_depth": (
-                    int(depths.max()) if len(depths) else 0
-                ),
+                "batches": self._batch_sizes.count,
+                "mean_batch_size": round(self._batch_sizes.mean, 2),
+                "mean_queue_depth": round(self._queue_depths.mean, 2),
+                "max_queue_depth": int(self._queue_depths.max),
             }
 
     def log_summary(self, logger, prefix: str = "serve") -> dict:
@@ -118,9 +231,18 @@ class ServeMetrics:
 
     def emit_event(self, bus) -> dict:
         """One ``serve`` record on the run-event bus (obs/): the same
-        summary the log line and the TB scalars carry, on the unified
+        summary the log line and the TB scalars carry — plus the latency
+        histogram sketch delta since the last periodic flush (sketches
+        are delta-semantics everywhere: merging this record with the
+        run's ``metrics`` events reconstructs the full distribution; with
+        no periodic emits it IS the full distribution) — on the unified
         timeline schema run_report merges."""
-        return bus.emit("serve", **self.summary())
+        hist = self._latency_hist.snapshot(reset=True)
+        payload = self.summary()
+        if hist is not None:
+            payload["latency_hist"] = hist
+            payload["latency_hist_summary"] = histogram_summary(hist)
+        return bus.emit("serve", **payload)
 
     def write_tensorboard(self, log_dir: str | Path, step: int = 0) -> None:
         """Write the summary as TB scalars through the framework's own

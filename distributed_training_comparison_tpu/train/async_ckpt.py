@@ -40,13 +40,18 @@ class AsyncCheckpointer:
     all, which matters when the device→host fetch is the expensive part).
     """
 
-    def __init__(self, max_pending: int = 16) -> None:
+    def __init__(self, max_pending: int = 16, metrics=None) -> None:
         self._q: queue.Queue = queue.Queue(maxsize=max_pending)
         self._latest: dict[str, Callable[[], object] | None] = {}
         self._lock = threading.Lock()
         self._errors: list[BaseException] = []
         self._busy_s = 0.0  # wall-clock the worker spent executing jobs
         self._depth = 0     # jobs submitted but not yet finished
+        # optional metric registry (obs/metrics.py): the queue depth as a
+        # live gauge + a write-seconds histogram, so the periodic
+        # `metrics` flush events track the writer BETWEEN the per-epoch
+        # `writer` gauges
+        self._metrics = metrics
         self._born = time.monotonic()
         self._thread = threading.Thread(
             target=self._worker, name="dtc-ckpt-writer", daemon=True
@@ -72,9 +77,15 @@ class AsyncCheckpointer:
                 with self._lock:
                     self._errors.append(e)
             finally:
+                took = time.monotonic() - t0
                 with self._lock:
-                    self._busy_s += time.monotonic() - t0
+                    self._busy_s += took
                     self._depth -= 1
+                    depth = self._depth
+                if self._metrics is not None:
+                    self._metrics.gauge("ckpt/queue_depth").set(depth)
+                    if job is not None:
+                        self._metrics.histogram("ckpt/write_s").record(took)
                 self._q.task_done()
 
     def stats(self) -> dict:
@@ -102,6 +113,10 @@ class AsyncCheckpointer:
         with self._lock:
             self._latest[key] = job
             self._depth += 1
+            depth = self._depth
+        if self._metrics is not None:
+            self._metrics.gauge("ckpt/queue_depth").set(depth)
+            self._metrics.counter("ckpt/jobs").inc()
         self._q.put(key)
 
     def _raise_collected(self) -> None:

@@ -394,7 +394,9 @@ class Trainer:
 
         # --- run dir, logging, provenance (process-0 only)
         self.is_main = is_main_process()
-        self.ckpt_writer = AsyncCheckpointer() if self.is_main else None
+        self.ckpt_writer = (
+            AsyncCheckpointer(metrics=self.metrics) if self.is_main else None
+        )
         self._last_resume_save = float("-inf")
         # -1 so the first validation always produces a best checkpoint, even
         # at 0.0% val accuracy (with 100 classes and a small val split that
@@ -473,6 +475,15 @@ class Trainer:
         )
         if self._obs_enabled and self._obs_dir is not None:
             self.bus.bind_dir(self._obs_dir)
+            if getattr(hparams, "flight_ring", True):
+                # durable twin of the flight recorder: an mmap'd fixed-slot
+                # file whose dirty pages the OS keeps even through SIGKILL —
+                # the supervisor (or run_report --blackbox) decodes every
+                # host's ring into one cross-host blackbox.json
+                self.bus.attach_ring(
+                    self._obs_dir
+                    / obs.ring_filename(self.bus.attempt, self.bus.process_index)
+                )
 
         # mid-epoch resume (host data mode): a checkpoint drained at a chunk
         # boundary records how many steps of the in-progress epoch it holds;
@@ -547,7 +558,7 @@ class Trainer:
         # step-time breakdown (h2d-wait / dispatch / compute): per-epoch
         # meter + run totals for the goodput record; the snapshot program
         # (device-side state copy for the async writer) compiles lazily
-        self._step_meter = StepTimeMeter(tracer=self.tracer)
+        self._step_meter = StepTimeMeter(tracer=self.tracer, metrics=self.metrics)
         self._overlap_totals = StepTimeMeter()
         self._snapshot_fn = None
         self._profiling = False  # True only during the --profile-dir epoch
@@ -612,6 +623,13 @@ class Trainer:
         self.tracer = obs.SpanRecorder(process_index=jax.process_index())
         self._prev_recorder = obs.set_recorder(self.tracer)
         self._obs_dir: Path | None = None
+        # per-step metrics (obs/metrics.py): grad_norm/loss/step-phase
+        # samples accumulate in typed sketches EVERY step; the bus sees one
+        # bounded `metrics` event per --metrics-flush-steps trained steps
+        # (checked at chunk boundaries) plus one per epoch end
+        self.metrics = obs.MetricRegistry(
+            flush_steps=getattr(hparams, "metrics_flush_steps", 50)
+        )
 
     def _ckpt_meta(self) -> dict:
         """Manifest metadata every resumable save carries: the saving mesh
@@ -799,6 +817,10 @@ class Trainer:
                 images_per_sec=round(imgs / epoch_time, 2),
                 step_breakdown=self._step_meter.summary(),
             )
+            # drain the sketches at every epoch boundary regardless of the
+            # step budget: per-attempt stats reconstruct exactly, and a
+            # preempted next epoch can lose at most ITS OWN steps' samples
+            self.metrics.flush(self.bus, epoch=epoch)
             for k, v in getattr(self, "_moe_health", {}).items():
                 # moe_dropped_frac → moe/dropped_frac, moe_load_max →
                 # moe/load_max: a collapsed router (load_max → 1.0) or
@@ -1007,7 +1029,14 @@ class Trainer:
         skipped = np.asarray(
             self._epoch_health.get("skipped", np.zeros(len(losses)))
         )
-        verdict = self.watchdog.observe_epoch(epoch, np.asarray(losses), skipped)
+        # spike baselines are per LR phase (the StepLR staircase shifts the
+        # whole loss distribution at each decay); the phase label is the
+        # schedule's value at this epoch's first step, so any schedule
+        # shape keys its own plateaus
+        phase = f"lr={float(self.lr_schedule(epoch * self.steps_per_epoch)):.6g}"
+        verdict = self.watchdog.observe_epoch(
+            epoch, np.asarray(losses), skipped, phase=phase
+        )
         if verdict.skipped:
             self._log_tb("health/skipped_steps", verdict.skipped, epoch)
             self.logger.warning(
@@ -1446,7 +1475,10 @@ class Trainer:
                 if self._profiling
                 else nullcontext()
             )
-            with ann, meter.phase("dispatch"):
+            # the step arg on the dispatch span is the join key run_report
+            # --xplane matches against the device capture's
+            # StepTraceAnnotations (same id as the annotation above)
+            with ann, meter.phase("dispatch", step=epoch * steps + done):
                 if fault is not None:
                     self.state, metrics = runner(*args, fault)
                 else:
@@ -1454,6 +1486,10 @@ class Trainer:
             meter.note_chunk()
             chunk_metrics.append(metrics)  # (take,) device arrays; no sync
             done += take
+            self.metrics.note_steps(take)
+            self.metrics.maybe_flush(
+                self.bus, epoch=epoch, step=epoch * steps + done
+            )
             if bar is not None:
                 bar.update(take)
             if done < steps and self._preempt_due(
@@ -1504,6 +1540,17 @@ class Trainer:
             for k in fetched[0]
             if k.startswith("moe_")
         }
+        # the per-step signals land in the metric sketches here — one
+        # vectorized pass over the stacked arrays, no per-step Python loop;
+        # non-finite samples count into the sketch's side counter, so a
+        # skipped step's inf grad norm can't poison the log buckets
+        self.metrics.histogram("train/loss").record_many(losses)
+        self.metrics.histogram("train/grad_norm").record_many(
+            self._epoch_health["grad_norm"]
+        )
+        n_skipped = int((np.asarray(self._epoch_health["skipped"]) > 0.5).sum())
+        if n_skipped:
+            self.metrics.counter("train/skipped_steps").inc(n_skipped)
         return losses, top1
 
     def _train_epoch_host(self, epoch: int) -> tuple[np.ndarray, float]:
@@ -1571,7 +1618,8 @@ class Trainer:
                     if self._profiling
                     else nullcontext()
                 )
-                with ann, meter.phase("dispatch"):
+                # step arg = the --xplane join key (see the device loop)
+                with ann, meter.phase("dispatch", step=epoch * steps + start):
                     args = (
                         self.state, batch["x"], batch["y"],
                         epoch_key, jnp.asarray(start),
@@ -1584,6 +1632,10 @@ class Trainer:
                 del batch  # donated at dispatch; drop the dead references
                 chunk_metrics.append(metrics)  # (take,) device arrays; no sync
                 done = start + take
+                self.metrics.note_steps(take)
+                self.metrics.maybe_flush(
+                    self.bus, epoch=epoch, step=epoch * steps + done
+                )
                 if bar is not None:
                     bar.update(take)
                 if done < steps and self._preempt_due(
@@ -1696,9 +1748,11 @@ class Trainer:
             self.ckpt_writer.close()
         if self.writer is not None:
             self.writer.close()
-        # obs teardown: export this attempt's host spans as a Chrome trace
-        # next to its events, then release the process-current bus/recorder
-        # (sequential Trainers in one process must not cross-write)
+        # obs teardown: drain any sketches the last partial epoch recorded,
+        # export this attempt's host spans as a Chrome trace next to its
+        # events, then release the process-current bus/recorder (sequential
+        # Trainers in one process must not cross-write)
+        self.metrics.flush(self.bus)
         if self._obs_enabled and self._obs_dir is not None:
             obs.write_chrome_trace(
                 self._obs_dir

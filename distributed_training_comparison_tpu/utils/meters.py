@@ -58,12 +58,18 @@ class StepTimeMeter:
 
     PHASES = ("h2d_wait", "dispatch", "compute")
 
-    def __init__(self, tracer=None) -> None:
+    def __init__(self, tracer=None, metrics=None) -> None:
         # optional span recorder (obs/spans.py): when set, every phase()
         # interval is ALSO recorded as a host span, so the Chrome-trace
         # export shows the same h2d_wait/dispatch/compute breakdown the
-        # scalar totals summarize
+        # scalar totals summarize.  Optional metric registry
+        # (obs/metrics.py): every phase interval additionally lands in a
+        # per-phase histogram sketch, so the periodic `metrics` flush
+        # events carry the step-phase DISTRIBUTION (p50/p95/p99), not just
+        # the epoch totals — a straggler chunk is visible even when the
+        # totals look healthy.
         self.tracer = tracer
+        self.metrics = metrics
         self.reset()
 
     def reset(self) -> None:
@@ -71,11 +77,21 @@ class StepTimeMeter:
         self.chunks = 0
 
     def add(self, phase: str, secs: float) -> None:
-        self.seconds[phase] += max(0.0, float(secs))
+        secs = max(0.0, float(secs))
+        self.seconds[phase] += secs
+        if self.metrics is not None:
+            self.metrics.histogram(f"step/{phase}_s").record(secs)
 
     @contextmanager
-    def phase(self, name: str):
-        ctx = self.tracer.span(name) if self.tracer is not None else nullcontext()
+    def phase(self, name: str, **attrs):
+        # attrs ride into the span's args — the trainer stamps the chunk's
+        # global step onto `dispatch`, the join key run_report --xplane
+        # matches against the device capture's StepTraceAnnotations
+        ctx = (
+            self.tracer.span(name, **attrs)
+            if self.tracer is not None
+            else nullcontext()
+        )
         t0 = time.perf_counter()
         try:
             with ctx:

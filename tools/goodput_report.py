@@ -32,7 +32,19 @@ def load_report(path: str | Path) -> dict:
             load_goodput_records,
         )
 
-        return aggregate_goodput(load_goodput_records(path))
+        records = load_goodput_records(path)
+        if any("kind" in r for r in records):
+            # a unified events.jsonl stream (obs bus): the goodput records
+            # ride `goodput`-kind events' payloads; every other kind —
+            # including the periodic `metrics` flushes — is not an attempt
+            # record and must not count as one
+            records = [
+                r.get("payload") or {}
+                for r in records
+                if r.get("kind") == "goodput"
+                and int(r.get("process_index", 0)) == 0
+            ]
+        return aggregate_goodput(records)
     return json.loads(path.read_bytes())
 
 

@@ -29,7 +29,12 @@ TAIL_EVENTS = 8
 
 
 def summarize_events(events: list[dict]) -> dict:
-    """Fold raw health.jsonl events into the HEALTH.json counter shape."""
+    """Fold raw health.jsonl events into the HEALTH.json counter shape.
+
+    Also accepts a unified ``events.jsonl`` stream (obs bus records): the
+    health kinds nest their fields under ``payload`` there, other kinds —
+    including the bulky periodic ``metrics`` flushes — are skipped, and
+    multi-host streams count each verdict once (process 0's)."""
     out = {
         "metric": "train_health",
         "skipped_steps": 0,
@@ -42,16 +47,19 @@ def summarize_events(events: list[dict]) -> dict:
     }
     for ev in events:
         kind = ev.get("kind")
+        if int(ev.get("process_index", 0)) != 0:
+            continue
+        p = ev.get("payload") or ev  # bus events nest under payload
         if kind == "skip":
-            out["skipped_steps"] += int(ev.get("count", 1))
+            out["skipped_steps"] += int(p.get("count", 1))
         elif kind == "spike":
-            out["spike_steps"] += int(ev.get("count", 1))
+            out["spike_steps"] += int(p.get("count", 1))
         elif kind == "desync":
             out["desyncs"] += 1
         elif kind == "rollback":
             out["rollbacks"] += 1
-            out["rollback_wasted_steps"] += int(ev.get("wasted_steps", 0))
-            out["rollback_wasted_s"] += float(ev.get("wasted_s", 0.0))
+            out["rollback_wasted_steps"] += int(p.get("wasted_steps", 0))
+            out["rollback_wasted_s"] += float(p.get("wasted_s", 0.0))
     return out
 
 
@@ -88,13 +96,19 @@ def format_table(reports: list[tuple[str, dict]]) -> str:
     # stamp (obs/: v/run_id/attempt/process_index/t_wall); older records
     # have none — both shapes are summarized identically, and the echo
     # below folds the stamp to an "a{attempt}" prefix instead of dumping it
-    stamp_keys = ("v", "run_id", "process_index", "t_wall", "attempt")
+    stamp_keys = ("v", "run_id", "process_index", "t_wall", "t_mono", "attempt")
     for name, rep in reports:
         events = rep.get("events") or []
         run_ids = {e["run_id"] for e in events if e.get("run_id")}
         if run_ids:
             tail.append(f"  [{name}] run {'+'.join(sorted(run_ids))}")
-        for ev in events[-TAIL_EVENTS:]:
+        # a unified stream's periodic `metrics` flushes are sketches, not
+        # health verdicts — they would bury the echo; condense them
+        echoable = [e for e in events if e.get("kind") != "metrics"]
+        n_metrics = len(events) - len(echoable)
+        if n_metrics:
+            tail.append(f"  [{name}] ({n_metrics} metrics flush(es) elided)")
+        for ev in echoable[-TAIL_EVENTS:]:
             prefix = f"a{ev['attempt']} " if "attempt" in ev else ""
             bare = {k: v for k, v in ev.items() if k not in stamp_keys}
             tail.append(f"  [{name}] {prefix}{json.dumps(bare)}")

@@ -6,13 +6,24 @@ Usage::
     python tools/run_report.py CKPT_ROOT --check      # schema validation
     python tools/run_report.py RUN_A RUN_B --diff     # compare two runs
     python tools/run_report.py version-0/events.jsonl --timeline 50
+    python tools/run_report.py CKPT_ROOT --follow     # tail an in-flight run
+    python tools/run_report.py CKPT_ROOT --blackbox   # decode flight rings
+    python tools/run_report.py CKPT_ROOT --xplane OUT.json \\
+        --profile-dir PROFILE_DIR                     # host+device Perfetto
 
 ``CKPT_ROOT`` is a training run's checkpoint root: every ``events*.jsonl``
 under it — the supervisor's at the root, each attempt's (and, multi-host,
 each process's) in the ``version-*`` dirs — is merged into ONE timeline
 ordered by wall clock, with per-attempt summaries: epochs trained, goodput
 phases, rollback causes, preemption points, checkpoint-writer busy
-fraction, and h2d wait.  A version dir or a single jsonl file also works.
+fraction, h2d wait, and the per-step metric sketches (``metrics`` events)
+reconstructed into grad-norm / step-phase p50/p95/p99.  A version dir or a
+single jsonl file also works.
+
+Cross-host merge no longer trusts NTP: per-host clock offsets are fitted
+from the ``run_start`` events every process emits together (post-broadcast,
+so near-simultaneous on the true timeline) and subtracted before ordering.
+One-host runs and runs without shared anchors merge unshifted.
 
 ``--check`` validates every record against the versioned event schema
 (``obs/bus.py``) and exits nonzero on any violation — bench legs run it so
@@ -21,20 +32,41 @@ a capture self-validates before anyone trusts the numbers.
 ``--diff`` compares the FIRST run against the second: the question an
 observability change answers is "did the second run absorb the same
 faults with less waste".
+
+``--follow`` tails every event file under the root (new attempts' files
+are picked up as they appear) and prints timeline lines as events land —
+the live view of an in-flight run.
+
+``--blackbox`` decodes every mmap flight ring (``flight*.ring`` — written
+by the SIGKILL-surviving recorder, torn pages dropped slot-wise) into one
+``blackbox.json`` at the root, the same pull the supervisor does after
+every attempt.
+
+``--xplane OUT --profile-dir DIR`` merges the host span traces
+(``trace*.json``) with the jax profiler's device capture into ONE Perfetto
+file, clocks joined on the ``StepTraceAnnotation`` step ids both sides
+carry.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 from collections import defaultdict
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from distributed_training_comparison_tpu.obs import (  # noqa: E402
+    collect_black_box,
+    decode_ring,
+    find_rings,
+    histogram_summary,
     load_events,
+    merge_metric_events,
     validate_event,
 )
 
@@ -55,14 +87,89 @@ def find_event_files(path: str | Path) -> list[Path]:
     )
 
 
-def load_run(path: str | Path) -> tuple[list[dict], list[Path]]:
-    """All events under ``path``, merged and wall-clock ordered."""
+def load_run(
+    path: str | Path, skew_out: dict[int, float] | None = None
+) -> tuple[list[dict], list[Path]]:
+    """All events under ``path``, merged and wall-clock ordered (per-host
+    clock skew estimated and removed before ordering).  ``skew_out``, if
+    given, receives the fitted per-process offsets — callers that report
+    them don't re-read the files."""
     files = find_event_files(path)
     events: list[dict] = []
     for f in files:
         events.extend(load_events(f))
+    offsets = estimate_clock_skew(events)
+    if skew_out is not None:
+        skew_out.update(offsets)
+    events = apply_clock_skew(events, offsets)
     events.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)))
     return events, files
+
+
+# -------------------------------------------------------------- clock skew
+#
+# Cross-host ordering used to assume NTP-sane clocks.  The anchor that
+# frees it from that assumption: every process emits ``run_start`` right
+# after a broadcast collective (the run-id agreement), so for one attempt
+# all hosts' ``run_start`` stamps name nearly the same true instant —
+# their differences are (almost entirely) clock offset, and every attempt
+# contributes one more anchor pair per host.  The supervisor's
+# ``attempt_start`` rows are NOT anchors: a single emitter (process 0's
+# timebase) has nothing to pair against, which is also why its events
+# need no fitting.
+
+# event kinds emitted near-simultaneously by every process of an attempt
+_SYNC_KINDS = ("run_start",)
+
+
+def estimate_clock_skew(events: list[dict]) -> dict[int, float]:
+    """Per-process wall-clock offset (seconds, relative to process 0)
+    fitted from the sync-anchor events: ``offset[p]`` is the median of
+    ``t_wall(anchor@p) - t_wall(anchor@0)`` over every shared
+    ``(attempt, kind)`` anchor.  One-host runs, processes with no shared
+    anchor (e.g. an attempt that died pre-``run_start``), and empty event
+    lists all yield offset 0 — the estimator degrades to the old merge,
+    never breaks it."""
+    # anchor[(attempt, kind)][process] = first t_wall seen
+    anchors: dict[tuple, dict[int, float]] = defaultdict(dict)
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in _SYNC_KINDS or ev.get("t_wall") is None:
+            continue
+        key = (ev.get("attempt", 0), kind)
+        anchors[key].setdefault(int(ev.get("process_index", 0)), ev["t_wall"])
+    deltas: dict[int, list[float]] = defaultdict(list)
+    for per_proc in anchors.values():
+        if 0 not in per_proc:
+            continue
+        for p, t in per_proc.items():
+            if p != 0:
+                deltas[p].append(t - per_proc[0])
+    processes = {int(e.get("process_index", 0)) for e in events}
+    offsets = {p: 0.0 for p in processes}
+    for p, ds in deltas.items():
+        ds = sorted(ds)
+        mid = len(ds) // 2
+        offsets[p] = (
+            ds[mid] if len(ds) % 2 else 0.5 * (ds[mid - 1] + ds[mid])
+        )
+    return offsets
+
+
+def apply_clock_skew(
+    events: list[dict], offsets: dict[int, float]
+) -> list[dict]:
+    """Shift each event's ``t_wall`` onto process 0's clock (events from
+    processes with a zero/absent offset pass through untouched)."""
+    if not any(abs(v) > 1e-9 for v in offsets.values()):
+        return events
+    out = []
+    for ev in events:
+        off = offsets.get(int(ev.get("process_index", 0)), 0.0)
+        if abs(off) > 1e-9 and ev.get("t_wall") is not None:
+            ev = dict(ev, t_wall=ev["t_wall"] - off)
+        out.append(ev)
+    return out
 
 
 def check_run(path: str | Path, counts: list | None = None) -> list[str]:
@@ -110,6 +217,7 @@ def summarize(events: list[dict]) -> dict:
             "skips": 0, "spikes": 0, "desyncs": 0, "aborts": [],
             "preempt": None, "goodput": None, "writer": None,
             "t_first": None, "t_last": None, "processes": set(),
+            "metrics_events": 0, "metrics": {},
         }
     )
     run_ids: set[str] = set()
@@ -158,6 +266,27 @@ def summarize(events: list[dict]) -> dict:
             a["goodput"] = p
         elif kind == "writer":
             a["writer"] = p  # last one wins (latest gauge)
+        elif kind == "metrics":
+            # fold the flush's sketches into the attempt's running merge —
+            # the associativity the sketch format guarantees is exactly
+            # what lets a summary accumulate event by event.  Process-0
+            # only (the gate above): grad_norm/loss are replicated global
+            # values every process records identically, and double-merging
+            # them would double every count.
+            a["metrics_events"] += 1
+            a["metrics"] = merge_metric_events(
+                [{"metrics": a["metrics"]}, ev]
+            )
+        elif kind == "serve" and p.get("latency_hist"):
+            # the serve record carries the latency sketch DELTA since the
+            # last periodic flush (ServeMetrics.emit_event) — merging it
+            # here completes the distribution the `metrics` events began
+            # (and IS the whole distribution for sessions shorter than
+            # the periodic emit interval)
+            a["metrics"] = merge_metric_events([
+                {"metrics": a["metrics"]},
+                {"metrics": {"serve/latency_s": p["latency_hist"]}},
+            ])
     overall = {
         "run_ids": sorted(run_ids),
         "attempts": {k: attempts[k] for k in sorted(attempts)},
@@ -241,6 +370,35 @@ def format_summary(name: str, s: dict) -> str:
             lines.append(f"  rollback (attempt {idx}) {cause}")
         for reason in a["aborts"]:
             lines.append(f"  abort (attempt {idx}) {reason}")
+    for idx, a in s["attempts"].items():
+        # per-step sketches reconstructed across this attempt's flushes:
+        # distribution stats nothing per-epoch could provide
+        if not a["metrics"]:
+            continue
+        lines.append(
+            f"  metrics (attempt {idx}, {a['metrics_events']} flush(es)):"
+        )
+        for nm in sorted(a["metrics"]):
+            snap = a["metrics"][nm]
+            if snap.get("type") == "histogram":
+                summ = histogram_summary(snap)
+                if summ is None:
+                    continue
+                lines.append(
+                    f"    {nm}: p50={summ['p50']:.4g} p95={summ['p95']:.4g} "
+                    f"p99={summ['p99']:.4g} mean={summ['mean']:.4g} "
+                    f"max={summ['max']:.4g} (n={summ['count']}"
+                    + (
+                        f", nonfinite={snap['nonfinite']}"
+                        if snap.get("nonfinite")
+                        else ""
+                    )
+                    + ")"
+                )
+            elif snap.get("type") == "counter":
+                lines.append(f"    {nm}: {snap.get('n', 0)}")
+            else:
+                lines.append(f"    {nm}: {snap.get('value')}")
     if s["supervisor"]:
         sup = ", ".join(
             f"{e['kind']}[a{_sup_attempt(e)}]" for e in s["supervisor"]
@@ -261,6 +419,34 @@ def _sup_attempt(ev: dict):
 # ---------------------------------------------------------------- timeline
 
 
+def format_event(ev: dict, t0: float) -> str:
+    """One timeline line (shared by the static tail and ``--follow``)."""
+    where = f"a{ev.get('attempt', '?')}/p{ev.get('process_index', '?')}"
+    at = ""
+    if "epoch" in ev:
+        at = f" epoch={ev['epoch']}"
+        if "step" in ev:
+            at += f" step={ev['step']}"
+    p = _payload(ev)
+    if ev.get("kind") == "metrics":
+        # a flush's payload is sketches — summarize instead of dumping
+        names = sorted((p.get("metrics") or {}))
+        brief = f"{len(names)} metric(s): " + ", ".join(names[:4]) + (
+            ", …" if len(names) > 4 else ""
+        )
+    else:
+        brief = ", ".join(
+            f"{k}={p[k]}"
+            for k in list(p)[:4]
+            if not isinstance(p[k], (dict, list))
+        )
+    return (
+        f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s {where:>7}] "
+        f"{ev.get('kind', '?')}{at}"
+        + (f"  ({brief})" if brief else "")
+    )
+
+
 def format_timeline(events: list[dict], tail: int = TIMELINE_TAIL) -> str:
     if not events:
         return "(no events)"
@@ -269,25 +455,144 @@ def format_timeline(events: list[dict], tail: int = TIMELINE_TAIL) -> str:
     shown = events[-tail:] if tail and tail > 0 else events
     if len(shown) < len(events):
         lines.append(f"... ({len(events) - len(shown)} earlier events)")
-    for ev in shown:
-        where = f"a{ev.get('attempt', '?')}/p{ev.get('process_index', '?')}"
-        at = ""
-        if "epoch" in ev:
-            at = f" epoch={ev['epoch']}"
-            if "step" in ev:
-                at += f" step={ev['step']}"
-        p = _payload(ev)
-        brief = ", ".join(
-            f"{k}={p[k]}"
-            for k in list(p)[:4]
-            if not isinstance(p[k], (dict, list))
-        )
-        lines.append(
-            f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s {where:>7}] "
-            f"{ev.get('kind', '?')}{at}"
-            + (f"  ({brief})" if brief else "")
-        )
+    lines.extend(format_event(ev, t0) for ev in shown)
     return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ follow
+
+
+def follow_events(
+    path: str | Path,
+    poll_s: float = 0.5,
+    max_polls: int | None = None,
+    sleep=time.sleep,
+):
+    """Yield batches of new events under ``path`` as they are appended —
+    the tail of an in-flight run.  Rescans for NEW files every poll (each
+    restart attempt opens its own ``events*.jsonl``), remembers a byte
+    offset per file, and never yields a torn trailing line (it stays
+    buffered until the writer completes it).  ``max_polls`` bounds the
+    loop for tests/scripting; None polls until interrupted."""
+    offsets: dict[Path, int] = {}
+    polls = 0
+    while True:
+        batch: list[dict] = []
+        for f in find_event_files(path):
+            pos = offsets.get(f, 0)
+            try:
+                with open(f, "rb") as fh:
+                    fh.seek(pos)
+                    chunk = fh.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            # only complete lines are consumed; a partial tail stays for
+            # the next poll
+            keep = chunk.rfind(b"\n") + 1
+            if keep == 0:
+                continue
+            offsets[f] = pos + keep
+            for line in chunk[:keep].splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    batch.append(json.loads(line))
+                except ValueError:
+                    continue
+        if batch:
+            batch.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)))
+            yield batch
+        polls += 1
+        if max_polls is not None and polls >= max_polls:
+            return
+        sleep(poll_s)
+
+
+# ---------------------------------------------------------------- blackbox
+
+
+def blackbox_report(path: str | Path, out=print) -> int:
+    """Decode every mmap flight ring under ``path`` into ``blackbox.json``
+    (the same pull the supervisor runs after every attempt) and print a
+    per-ring summary.  Exit 0 when rings decoded, 2 when none exist."""
+    rings = find_rings(path)
+    if not rings:
+        out(f"{path}: no flight*.ring files found")
+        return 2
+    for ring in rings:
+        events, torn = decode_ring(ring)
+        last = events[-1] if events else {}
+        out(
+            f"{ring}: {len(events)} event(s), {torn} torn slot(s)"
+            + (
+                f", last kind={last.get('kind')!r} "
+                f"epoch={last.get('epoch')}"
+                if events
+                else ""
+            )
+        )
+    box = collect_black_box(path)
+    if box is None:
+        out(f"{path}: black box write failed")
+        return 1
+    out(f"black box written: {box}")
+    return 0
+
+
+# ------------------------------------------------------------------ xplane
+
+
+def find_host_traces(path: str | Path) -> list[Path]:
+    """Every host span trace under a ckpt root (``trace*.json`` at the
+    root and in the version dirs) — the files Trainer.close exports."""
+    p = Path(path)
+    if p.is_file():
+        return [p]
+    return sorted(p.glob("trace*.json")) + sorted(
+        p.glob("version-*/trace*.json")
+    )
+
+
+def xplane_merge(
+    path: str | Path, profile_dir: str | Path, out_path: str | Path,
+    log=print,
+) -> int:
+    """ONE Perfetto file from the run's host span traces + its
+    ``--profile-dir`` capture, clocks joined on the step ids both sides
+    stamp (host ``dispatch`` spans' ``step`` args ↔ the xplane's
+    ``StepTraceAnnotation`` events)."""
+    from distributed_training_comparison_tpu.obs.xplane import (
+        load_profiler_chrome_events,
+        merge_host_and_xplane,
+    )
+
+    trace_files = find_host_traces(path)
+    host_traces = []
+    for f in trace_files:
+        try:
+            host_traces.append(json.loads(f.read_text()))
+        except (OSError, ValueError) as e:
+            log(f"skipping unreadable host trace {f}: {e}")
+    profiler_events = load_profiler_chrome_events(profile_dir)
+    if not host_traces and not profiler_events:
+        log(f"nothing to merge: no trace*.json under {path} and no "
+            f"xplane/trace artifacts under {profile_dir}")
+        return 2
+    doc, info = merge_host_and_xplane(host_traces, profiler_events)
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    log(
+        f"merged {info['host_traces']} host trace(s) + "
+        f"{info['profiler_events']} device event(s) → {out_path} "
+        f"(aligned on {info['aligned']}, {info['matched_steps']} shared "
+        f"step id(s), offset {info['offset_us'] / 1e3:.3f} ms)"
+    )
+    return 0
 
 
 # -------------------------------------------------------------------- diff
@@ -340,7 +645,59 @@ def main(argv: list[str]) -> int:
         "--timeline", type=int, default=TIMELINE_TAIL, metavar="N",
         help=f"show the last N timeline events (0 = all; default {TIMELINE_TAIL})",
     )
+    ap.add_argument(
+        "--follow", action="store_true",
+        help="tail the event files (new attempts' files picked up live); "
+        "Ctrl-C to stop",
+    )
+    ap.add_argument(
+        "--poll", type=float, default=0.5, metavar="SECS",
+        help="--follow poll interval (default 0.5s)",
+    )
+    ap.add_argument(
+        "--blackbox", action="store_true",
+        help="decode every flight*.ring under the path into blackbox.json "
+        "(the SIGKILL-surviving recorder's pull)",
+    )
+    ap.add_argument(
+        "--xplane", metavar="OUT.json", default=None,
+        help="write ONE Perfetto file merging the run's host span traces "
+        "with the --profile-dir device capture, joined on step ids",
+    )
+    ap.add_argument(
+        "--profile-dir", metavar="DIR", default=None,
+        help="the jax profiler capture dir --xplane merges in",
+    )
     args = ap.parse_args(argv)
+
+    if args.xplane is not None:
+        if args.profile_dir is None:
+            print("--xplane needs --profile-dir", file=sys.stderr)
+            return 2
+        return xplane_merge(args.paths[0], args.profile_dir, args.xplane)
+
+    if args.blackbox:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, blackbox_report(path))
+        return rc
+
+    if args.follow:
+        t0: float | None = None
+        try:
+            for batch in follow_events(args.paths[0], poll_s=args.poll):
+                if t0 is None:
+                    t0 = batch[0].get("t_wall", 0.0)
+                for ev in batch:
+                    print(format_event(ev, t0), flush=True)
+        except KeyboardInterrupt:
+            pass
+        except BrokenPipeError:
+            # `--follow | head` / `| grep -m1` closing the pipe is a
+            # normal way to stop tailing, not an error
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, sys.stdout.fileno())
+        return 0
 
     if args.check:
         rc = 0
@@ -370,12 +727,21 @@ def main(argv: list[str]) -> int:
 
     rc = 0
     for path in args.paths:
-        events, files = load_run(path)
+        offsets: dict[int, float] = {}
+        events, files = load_run(path, skew_out=offsets)
         if not events:
             print(f"{path}: no events found", file=sys.stderr)
             rc = 2
             continue
         print(format_summary(str(path), summarize(events)))
+        skew = {p: off for p, off in offsets.items() if abs(off) > 1e-3}
+        if skew:
+            print(
+                "  clock skew removed before merge: "
+                + ", ".join(
+                    f"p{p} {off:+.3f}s" for p, off in sorted(skew.items())
+                )
+            )
         print()
         print(format_timeline(events, args.timeline))
     return rc
