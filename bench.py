@@ -939,17 +939,23 @@ def bench_obs_overhead(
     The deal obs/metrics.py offers the trainer is "record every step,
     bounded bus traffic"; this leg prices the record side.  Two identical
     loops run the trainer's per-step accounting shape — per chunk: three
-    ``StepTimeMeter`` phase intervals, ``note_steps`` + ``maybe_flush``
-    against a real bound bus with the mmap flight ring attached; per
-    epoch: one vectorized ``record_many`` pass for the stacked
-    grad_norm/loss arrays — once with the registry wired and once with
-    telemetry off (``metrics=None``, no bus).  The difference per step
-    must stay under ``budget_us_per_step`` (microseconds — the stated
-    budget; a CIFAR step is ~10ms on one TPU core, so 25µs is <0.3%).
-    The capture self-validates: the flush events the measured loop
-    emitted are schema-checked by ``run_report --check``
-    (``events_check_rc``), and ``within_budget`` records the verdict the
-    slow-marked test asserts.
+    ``StepTimeMeter`` phase intervals, ``note_steps`` + the heartbeat's
+    cadence check + ``maybe_flush`` (with the resource gauges sampled on
+    flush-due windows) against a real bound bus with the mmap flight ring
+    attached; per epoch: one vectorized ``record_many`` pass for the
+    stacked grad_norm/loss arrays — once with the registry wired and once
+    with telemetry off (``metrics=None``, no bus).  The difference per
+    step must stay under ``budget_us_per_step`` (microseconds — the
+    stated budget; a CIFAR step is ~10ms on one TPU core, so 25µs is
+    <0.3%).  A second leg reprices the same machinery *inside a real
+    training run* (tiny conv net, heartbeats at 1s, a live
+    ``--metrics-port`` exporter scraped mid-fit) — informational on a CPU
+    container, where run-to-run step-time noise is orders of magnitude
+    above the budget (see the committed record's ``note``); the budget
+    verdict stays on the synthetic leg.  The capture self-validates: the
+    flush events the measured loops emitted are schema-checked by
+    ``run_report --check`` (``events_check_rc``), and ``within_budget``
+    records the verdict the slow-marked test asserts.
     """
     import tempfile
     from pathlib import Path
@@ -966,16 +972,26 @@ def bench_obs_overhead(
     ckpt_root = tempfile.mkdtemp(prefix="obs-bench-")
 
     def run_loop(with_obs: bool) -> tuple[float, int]:
+        import urllib.request
+
         obs.reset()
         bus = obs.configure(run_id=obs.new_run_id(), persist=with_obs)
         flushes = 0
+        exporter = None
         if with_obs:
             bus.bind_dir(ckpt_root)
             bus.attach_ring(Path(ckpt_root) / obs.ring_filename())
             registry = obs.MetricRegistry(flush_steps=50)
+            heartbeat = obs.HeartbeatEmitter(bus, every_s=10.0)
+            resources = obs.ResourceSampler(ckpt_root=ckpt_root)
+            # the live endpoint idles on its thread for the whole measured
+            # loop and serves ONE scrape mid-loop, so within_budget prices
+            # the exporter too, not just the record path
+            exporter = obs.MetricsExporter(port=0, registry=registry).start()
         else:
             registry = None
         meter = StepTimeMeter(metrics=registry)
+        scraped = False
         t0 = time.perf_counter()
         done = 0
         while done < steps:
@@ -988,15 +1004,27 @@ def bench_obs_overhead(
             done += take
             if registry is not None:
                 registry.note_steps(take)
-                registry.maybe_flush(bus, epoch=0, step=done)
+                # the trainer's _obs_tick shape: cadence-checked heartbeat,
+                # resource gauges only on flush-due windows, then the flush
+                heartbeat.beat(epoch=0, step=done, flush_seq=registry.flushes)
+                if registry.flush_due():
+                    resources.sample(registry)
+                    registry.maybe_flush(bus, epoch=0, step=done)
             if done % epoch_len == 0 and registry is not None:
                 # the per-epoch stacked-array pass (vectorized, not per-step)
                 registry.histogram("train/grad_norm").record_many(grad_norms)
                 registry.histogram("train/loss").record_many(losses)
                 registry.flush(bus, epoch=done // epoch_len)
+            if not scraped and done >= steps // 2 and exporter is not None:
+                scraped = True
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{exporter.port}/metrics", timeout=5
+                ).read()
         elapsed = time.perf_counter() - t0
         if registry is not None:
             flushes = registry.flushes
+        if exporter is not None:
+            exporter.close()
         obs.reset()
         return elapsed, flushes
 
@@ -1004,6 +1032,7 @@ def bench_obs_overhead(
     with_t, flushes = run_loop(True)
     without_t, _ = run_loop(False)
     overhead_us = (with_t - without_t) / steps * 1e6
+    real = _bench_obs_real_step(Path(ckpt_root))
     record = {
         "metric": "obs_overhead",
         "steps": steps,
@@ -1014,6 +1043,7 @@ def bench_obs_overhead(
         "overhead_us_per_step": round(overhead_us, 3),
         "budget_us_per_step": budget_us_per_step,
         "within_budget": bool(overhead_us < budget_us_per_step),
+        "real_step": real,
         "events_check_rc": events_check_rc(ckpt_root),
         "platform": jax.devices()[0].platform,
     }
@@ -1023,8 +1053,126 @@ def bench_obs_overhead(
     print(json.dumps({k: record[k] for k in (
         "metric", "steps", "flushes", "overhead_us_per_step",
         "budget_us_per_step", "within_budget", "events_check_rc", "platform",
-    )} | {"full_record": out_path}))
+    )} | {
+        "real_step_overhead_us": real.get("overhead_us_per_step"),
+        "scrape_ok": real.get("scrape_ok"),
+        "full_record": out_path,
+    }))
     return record
+
+
+def _bench_obs_real_step(ckpt_root) -> dict:
+    """Price record + heartbeat + one live exporter scrape INSIDE a real
+    training step: the same tiny-net trainer the e2e tests drive, run
+    once with the full live-operations plane (metrics + 1s heartbeats +
+    mmap ring + an OpenMetrics endpoint scraped mid-fit) and once with
+    ``--no-obs``; the per-step delta is the measured price.  On the CPU
+    container this number is DOMINATED by run-to-run jitter (a CPU
+    trainer step is ~ms with >10% variance — hundreds of µs — against a
+    25µs budget), so the committed record carries it as informational
+    with a caveat; recapture on a real TPU host for a binding number.
+    """
+    import threading
+    import urllib.request
+
+    import flax.linen as lnn
+
+    from distributed_training_comparison_tpu import obs
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.train import Trainer
+
+    class BenchNet(lnn.Module):
+        """Same shape as the e2e tests' TinyNet: conv+BN+dense."""
+
+        num_classes: int = 100
+
+        @lnn.compact
+        def __call__(self, x, train: bool = False):
+            x = lnn.Conv(8, (3, 3), strides=2, use_bias=False)(x)
+            x = lnn.BatchNorm(use_running_average=not train)(x)
+            x = lnn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return lnn.Dense(self.num_classes)(x)
+
+    epochs, steps_per_epoch = 4, 18  # 640-example synthetic split @ bs 32
+
+    def run(with_obs: bool, tag: str) -> tuple[float, dict]:
+        obs.reset()
+        argv = [
+            "--synthetic-data", "--limit-examples", "640",
+            "--batch-size", "32", "--epoch", str(epochs),
+            "--no-progress", "--eval-step", "10000",
+            "--save-last-min-secs", "0", "--seed", "7",
+            "--device-chunk-steps", "6",  # chunk boundaries = beat points
+            "--ckpt-path", str(ckpt_root / f"real-{tag}"),
+        ]
+        if with_obs:
+            argv += [
+                "--metrics-flush-steps", "8", "--heartbeat-secs", "1",
+                "--metrics-port", "0",  # flag 0 = off; bench binds its own
+            ]
+        else:
+            argv += ["--no-obs", "--no-flight-ring"]
+        hp = load_config("tpu", argv)
+        trainer = Trainer(hp, model=BenchNet())
+        scrape: dict = {}
+        if with_obs:
+            # the live endpoint, on an ephemeral port, scraped while fit()
+            # runs — the scrape itself is part of what this leg prices
+            trainer.exporter = obs.MetricsExporter(
+                port=0, registry=trainer.metrics,
+                heartbeats=trainer.heartbeat,
+            ).start()
+
+            def scraper():
+                # retry until the exposition carries real metric families
+                # (an empty pre-training scrape is just "# EOF")
+                url = f"http://127.0.0.1:{trainer.exporter.port}/metrics"
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    time.sleep(0.1)
+                    try:
+                        with urllib.request.urlopen(url, timeout=2) as r:
+                            body = r.read()
+                    except OSError:
+                        continue
+                    if b"dtc_train_loss" in body:
+                        scrape.update(ok=True, bytes=len(body))
+                        return
+                scrape.update(ok=False)
+
+            threading.Thread(target=scraper, daemon=True).start()
+        t0 = time.perf_counter()
+        try:
+            trainer.fit()
+        finally:
+            elapsed = time.perf_counter() - t0
+            if with_obs:
+                scrape.setdefault("ok", False)
+                scrape["heartbeats"] = trainer.heartbeat.emitted
+            trainer.close()
+        obs.reset()
+        return elapsed, scrape
+
+    run(True, "warmup")  # compile + file-creation warmup for both legs
+    with_t, scrape = run(True, "on")
+    without_t, _ = run(False, "off")
+    steps = epochs * steps_per_epoch
+    return {
+        "steps": steps,
+        "with_obs_s": round(with_t, 4),
+        "without_obs_s": round(without_t, 4),
+        "overhead_us_per_step": round((with_t - without_t) / steps * 1e6, 1),
+        "scrape_ok": bool(scrape.get("ok")),
+        "scrape_bytes": scrape.get("bytes", 0),
+        "heartbeats": scrape.get("heartbeats", 0),
+        "note": (
+            "informational on CPU: per-step jitter of a CPU trainer run "
+            "(~ms steps, eval + checkpoint in the loop) is far above the "
+            "25us budget; the budget verdict is the synthetic leg's. "
+            "Recapture on a TPU host for a binding in-step price."
+        ),
+    }
 
 
 def bench_overlap(out_path: str = "BENCH_OVERLAP.json") -> dict:

@@ -105,6 +105,26 @@ def donated_cache_write_barred():
     return _min_compile_secs(1e18)
 
 
+def device_memory_stats(device) -> dict | None:
+    """``device.memory_stats()`` normalized across backends: a dict with
+    at least ``bytes_in_use`` on allocator-backed devices (TPU/GPU), and
+    ``None`` wherever the stats don't exist — the CPU CI backend returns
+    None or raises depending on the jax release, and older Device classes
+    lack the method entirely.  Callers treat None as "no HBM gauge here",
+    never as an error."""
+    if device is None:
+        return None
+    fn = getattr(device, "memory_stats", None)
+    if fn is None:
+        return None
+    try:
+        stats = fn()
+    except Exception:
+        return None
+    return stats if isinstance(stats, dict) and stats else None
+
+
 __all__ = [
     "shard_map", "axis_size", "CompilerParams", "donated_cache_write_barred",
+    "device_memory_stats",
 ]

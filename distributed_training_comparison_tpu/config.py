@@ -605,6 +605,47 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "p50/p95/p99 for any slice of the run from the event stream",
     )
     parser.add_argument(
+        "--heartbeat-secs",
+        type=float,
+        default=10.0,
+        metavar="S",
+        help="Liveness cadence: each process emits a tiny 'heartbeat' "
+        "event (position + metric-flush sequence) at most once per S "
+        "seconds, checked at the chunk boundaries the trainer already "
+        "touches.  The supervisor's fleet watcher classifies a host whose "
+        "heartbeats go stale as slow (3 missed beats) vs dead (10) and "
+        "emits a 'stall' event before the collective wedges.  0 disables "
+        "heartbeats (and therefore stall detection)",
+    )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        metavar="PORT",
+        help="OpenMetrics text-exposition endpoint: each process serves "
+        "its live metric registry (cumulative counters/histograms), "
+        "heartbeat age, and alert states at http://:PORT+process_index"
+        "/metrics from a stdlib http.server thread.  0 (default) = off; "
+        "scrape-less setups can render the same exposition offline with "
+        "run_report --export-openmetrics",
+    )
+    parser.add_argument(
+        "--alert",
+        action="append",
+        default=None,
+        metavar="SPEC",
+        help="Declarative alert rule, repeatable: METRIC:AGG{><}THRESHOLD"
+        "[:for=N], e.g. 'serve/latency_s:p99>0.25:for=3' (p99 above 250ms "
+        "for 3 consecutive flush windows) or 'heartbeat:age>30' (any "
+        "process silent 30s).  AGG: p50/p95/p99/mean/max/min/count (histo"
+        "grams), value (gauges), n (counters), age (heartbeat).  for=N is "
+        "the hysteresis: N consecutive breaching windows to fire, N clean "
+        "ones to resolve.  Evaluated by the supervisor over every host's "
+        "stream (in-process for unsupervised runs); transitions emit "
+        "firing/resolved 'alert' events that run_report --alerts turns "
+        "into a timeline and a CI exit code",
+    )
+    parser.add_argument(
         "--health-phase-baselines",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -668,6 +709,23 @@ def load_config(
         parser.error(
             f"--device-prefetch must be >= 0, got {args.device_prefetch}"
         )
+    if args.heartbeat_secs < 0:
+        parser.error(
+            f"--heartbeat-secs must be >= 0, got {args.heartbeat_secs}"
+        )
+    if not 0 <= args.metrics_port <= 65535:
+        parser.error(
+            f"--metrics-port must be in [0, 65535], got {args.metrics_port}"
+        )
+    if args.alert:
+        # a malformed alert rule must die at the CLI, not at the first
+        # flush of a run that already burned its startup/compile time
+        from .obs.alerts import AlertSpecError, parse_alert_specs
+
+        try:
+            parse_alert_specs(args.alert)
+        except AlertSpecError as e:
+            parser.error(str(e))
     if args.fault_plan:
         # a malformed fault plan must die at the CLI, not at epoch 0 of a
         # run that already burned its startup/compile time

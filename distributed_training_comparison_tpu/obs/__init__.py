@@ -30,7 +30,26 @@ of attempt 3".  ``obs`` is the one layer they all now report through:
 - ``xplane.py`` — a dependency-free reader for the jax profiler's
   ``*.xplane.pb`` captures, used by ``run_report --xplane`` to merge host
   spans and the device trace into ONE Perfetto file joined on the
-  ``StepTraceAnnotation`` step ids.
+  ``StepTraceAnnotation`` step ids;
+- ``heartbeat.py`` — **liveness**: bounded-cadence per-process
+  ``heartbeat`` events, the supervisor-side tracker that classifies a
+  lagging host as slow vs dead (``stall`` events before the collective
+  wedges), and the fleet watcher thread that tails the event files live;
+- ``straggler.py`` — **cross-host attribution**: merge every host's
+  step-phase sketches and score each host's p95 against the rest of the
+  fleet (median/MAD, leave-one-out), emitting ``straggler`` events that
+  name host + phase;
+- ``resource.py`` — device HBM (``memory_stats`` guarded through
+  ``_compat``), host RSS, open fds, and ckpt-root disk-free gauges,
+  sampled once per metric flush;
+- ``exporter.py`` — an **OpenMetrics** ``/metrics`` endpoint per process
+  (``--metrics-port``) rendering the live registry, heartbeat ages, and
+  alert states; the same renderer serves ``run_report
+  --export-openmetrics`` offline;
+- ``alerts.py`` — declarative ``--alert`` rules (e.g.
+  ``serve/latency_s:p99>0.25:for=3``) evaluated over flushed metric
+  events and heartbeats, with hysteresis and firing/``resolved``
+  ``alert`` events ``run_report --alerts`` gates CI on.
 
 The process holds ONE current bus and ONE current span recorder
 (``configure`` installs them; ``emit``/``span`` reach them from any
@@ -54,10 +73,20 @@ from .blackbox import (
     find_rings,
     ring_filename,
 )
+from .alerts import (
+    ALERT_KIND,
+    AlertEngine,
+    AlertRule,
+    AlertSpecError,
+    alert_timeline,
+    final_states,
+    parse_alert_specs,
+)
 from .bus import (
     ATTEMPT_ENV,
     CRASH_DUMP_NAME,
     EVENTS_NAME,
+    KNOWN_KINDS,
     RUN_ID_ENV,
     SCHEMA_VERSION,
     EventBus,
@@ -68,8 +97,23 @@ from .bus import (
     events_filename,
     load_events,
     new_run_id,
+    register_kind,
     reset,
     validate_event,
+)
+from .exporter import (
+    MetricsExporter,
+    openmetrics_name,
+    render_openmetrics,
+    start_exporter,
+)
+from .heartbeat import (
+    HEARTBEAT_KIND,
+    STALL_KIND,
+    EventTailer,
+    FleetWatcher,
+    HeartbeatEmitter,
+    LivenessTracker,
 )
 from .metrics import (
     METRICS_KIND,
@@ -82,6 +126,7 @@ from .metrics import (
     merge_histograms,
     merge_metric_events,
 )
+from .resource import ResourceSampler
 from .spans import (
     SpanRecorder,
     chrome_trace,
@@ -92,6 +137,13 @@ from .spans import (
     trace_filename,
     write_chrome_trace,
 )
+from .straggler import (
+    STRAGGLER_KIND,
+    emit_straggler_events,
+    host_phase_table,
+    straggler_findings,
+)
+from . import straggler  # noqa: F401 (run_report renders its table)
 
 __all__ = [
     "SCHEMA_VERSION",
@@ -99,8 +151,32 @@ __all__ = [
     "CRASH_DUMP_NAME",
     "BLACKBOX_NAME",
     "METRICS_KIND",
+    "HEARTBEAT_KIND",
+    "STALL_KIND",
+    "STRAGGLER_KIND",
+    "ALERT_KIND",
+    "KNOWN_KINDS",
     "RUN_ID_ENV",
     "ATTEMPT_ENV",
+    "AlertEngine",
+    "AlertRule",
+    "AlertSpecError",
+    "alert_timeline",
+    "final_states",
+    "parse_alert_specs",
+    "EventTailer",
+    "FleetWatcher",
+    "HeartbeatEmitter",
+    "LivenessTracker",
+    "MetricsExporter",
+    "openmetrics_name",
+    "render_openmetrics",
+    "start_exporter",
+    "register_kind",
+    "ResourceSampler",
+    "emit_straggler_events",
+    "host_phase_table",
+    "straggler_findings",
     "EventBus",
     "MmapRing",
     "collect_black_box",

@@ -1,0 +1,328 @@
+"""Declarative alert rules evaluated over the run-event stream.
+
+The metric sketches, heartbeats, and resource gauges make a run's state
+*observable*; this module makes it *actionable* without a human watching
+``--follow``: ``--alert`` specs compile to rules, every ``metrics`` flush
+(and every liveness tick) is an evaluation window, and state transitions
+emit ``alert`` events — ``firing`` / ``resolved`` pairs that land on the
+same timeline as everything else, which ``run_report --alerts`` turns
+into an exit code CI can gate on.
+
+Spec grammar (one ``--alert`` flag per rule, repeatable)::
+
+    METRIC:AGG CMP THRESHOLD[:for=N]
+
+    serve/latency_s:p99>0.25:for=3     p99 of the latency sketch above
+                                       250ms for 3 consecutive windows
+    train/grad_norm:p95>10             histogram quantile, fire on the
+                                       first breaching window
+    res/disk_free_bytes:value<1e9      gauge compared directly
+    train/skipped_steps:n>0            counter delta per window
+    heartbeat:age>30                   any process silent for 30s
+                                       (evaluated on the liveness tick,
+                                       not on flushes)
+
+``AGG`` ∈ ``p50 p95 p99 mean max min count value n age``; ``CMP`` ∈
+``> <``.  ``for=N`` (default 1) is the hysteresis: a rule fires only
+after N *consecutive* breaching windows and resolves only after N
+consecutive clean ones — one noisy window can neither page nor
+silence.  Evaluation is per emitting process (host 1's latency breach
+must not be averaged away by host 0), with the process index carried in
+the ``alert`` payload.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+
+from .metrics import histogram_quantile
+
+ALERT_KIND = "alert"
+
+_AGGS = ("p50", "p95", "p99", "mean", "max", "min", "count", "value", "n", "age")
+_SPEC_RE = re.compile(
+    r"^(?P<metric>[\w./-]+):(?P<agg>[a-z0-9]+)\s*(?P<cmp>[<>])\s*"
+    r"(?P<threshold>[-+0-9.eE]+)(?::for=(?P<for>\d+))?$"
+)
+
+
+class AlertSpecError(ValueError):
+    pass
+
+
+class AlertRule:
+    """One compiled ``--alert`` spec."""
+
+    def __init__(
+        self, metric: str, agg: str, cmp: str, threshold: float,
+        for_windows: int = 1, spec: str | None = None,
+    ) -> None:
+        self.metric = metric
+        self.agg = agg
+        self.cmp = cmp
+        self.threshold = float(threshold)
+        self.for_windows = max(1, int(for_windows))
+        self.spec = spec or f"{metric}:{agg}{cmp}{threshold}:for={for_windows}"
+
+    @classmethod
+    def parse(cls, spec: str) -> "AlertRule":
+        m = _SPEC_RE.match(spec.strip())
+        if m is None:
+            raise AlertSpecError(
+                f"malformed --alert spec {spec!r}; expected "
+                "METRIC:AGG[<>]THRESHOLD[:for=N], e.g. "
+                "'serve/latency_s:p99>0.25:for=3'"
+            )
+        agg = m.group("agg")
+        if agg not in _AGGS:
+            raise AlertSpecError(
+                f"--alert {spec!r}: unknown aggregation {agg!r} "
+                f"(choose from {', '.join(_AGGS)})"
+            )
+        try:
+            threshold = float(m.group("threshold"))
+        except ValueError:
+            raise AlertSpecError(
+                f"--alert {spec!r}: threshold {m.group('threshold')!r} "
+                "is not a number"
+            ) from None
+        if m.group("metric") == "heartbeat" and agg != "age":
+            raise AlertSpecError(
+                f"--alert {spec!r}: the heartbeat pseudo-metric supports "
+                "only the 'age' aggregation"
+            )
+        if agg == "age" and m.group("metric") != "heartbeat":
+            raise AlertSpecError(
+                f"--alert {spec!r}: 'age' applies only to the heartbeat "
+                "pseudo-metric"
+            )
+        return cls(
+            m.group("metric"), agg, m.group("cmp"), threshold,
+            int(m.group("for") or 1), spec=spec.strip(),
+        )
+
+    @property
+    def on_heartbeat(self) -> bool:
+        return self.agg == "age"
+
+    def value_of(self, snap: dict) -> float | None:
+        """Extract this rule's aggregation from one metric snapshot."""
+        if not isinstance(snap, dict):
+            return None
+        if self.agg in ("p50", "p95", "p99"):
+            q = int(self.agg[1:]) / 100.0
+            return histogram_quantile(snap, q)
+        if self.agg == "mean":
+            count = snap.get("count")
+            return snap.get("sum", 0.0) / count if count else None
+        if self.agg in ("max", "min", "count"):
+            return snap.get(self.agg)
+        if self.agg == "value":
+            return snap.get("value")
+        if self.agg == "n":
+            return snap.get("n")
+        return None
+
+    def breached(self, value: float | None) -> bool:
+        if value is None:
+            return False
+        return value > self.threshold if self.cmp == ">" else value < self.threshold
+
+
+def parse_alert_specs(specs) -> list[AlertRule]:
+    """Compile a list of ``--alert`` strings (raises ``AlertSpecError``
+    on the first malformed one — a bad rule dies at the CLI, not at the
+    first flush of a run that already burned its startup)."""
+    return [AlertRule.parse(s) for s in (specs or [])]
+
+
+class _RuleState:
+    __slots__ = ("breaches", "oks", "firing", "last_value")
+
+    def __init__(self) -> None:
+        self.breaches = 0
+        self.oks = 0
+        self.firing = False
+        self.last_value: float | None = None
+
+
+class AlertEngine:
+    """Evaluate rules over observed events; emit transitions on ``bus``.
+
+    Feed it the event stream (``observe_event`` — the supervisor's fleet
+    watcher and the in-process bus tap both do) and a periodic ``tick``
+    for the heartbeat-age rules.  State is per (rule, process); the
+    engine ignores its own ``alert`` events, so wiring it as a bus
+    subscriber cannot recurse.
+    """
+
+    def __init__(self, rules, bus=None, heartbeats=None) -> None:
+        self.rules = list(rules)
+        self.bus = bus
+        # liveness source for age rules: an object with ages(now) -> dict
+        # (HeartbeatEmitter or LivenessTracker)
+        self.heartbeats = heartbeats
+        self._state: dict[tuple[int, object], _RuleState] = {}
+        self.transitions: list[dict] = []
+        # one lock over the hysteresis state: observe_event runs on
+        # whatever thread emits (serve's request threads, the trainer
+        # loop) and tick on the ticker thread — unsynchronized, two
+        # threads could both see breaches==N and double-emit "firing"
+        self._lock = threading.Lock()
+        self._ticker: threading.Thread | None = None
+        self._stop_ticker = threading.Event()
+
+    def start_ticker(self, interval_s: float = 1.0) -> "AlertEngine":
+        """Tick the heartbeat-age rules from a daemon thread.
+
+        An in-process engine whose ``tick`` only runs on the monitored
+        thread can never see that thread hang — the tick stops with it
+        (and, ticked right after a ``beat``, the age it measures is
+        always ~0).  The ticker evaluates on its own clock; a wedged
+        main thread blocked inside a device call releases the GIL, so
+        the age rule fires.  No-op without age rules or a liveness
+        source."""
+        if self._ticker is not None or self.heartbeats is None or not any(
+            r.on_heartbeat for r in self.rules
+        ):
+            return self
+        self._stop_ticker.clear()
+
+        def loop():
+            while not self._stop_ticker.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:  # alerting must never kill training
+                    pass
+
+        self._ticker = threading.Thread(
+            target=loop, name="alert-ticker", daemon=True
+        )
+        self._ticker.start()
+        return self
+
+    def close(self) -> None:
+        if self._ticker is not None:
+            self._stop_ticker.set()
+            self._ticker.join(timeout=5.0)
+            self._ticker = None
+
+    def _observe_value(
+        self, rule_idx: int, key, value: float | None, now_info: dict
+    ) -> None:
+        rule = self.rules[rule_idx]
+        if value is None:
+            return
+        fire = None
+        with self._lock:
+            st = self._state.setdefault((rule_idx, key), _RuleState())
+            st.last_value = value
+            if rule.breached(value):
+                st.breaches += 1
+                st.oks = 0
+                if not st.firing and st.breaches >= rule.for_windows:
+                    st.firing = True
+                    fire = "firing"
+            else:
+                st.oks += 1
+                st.breaches = 0
+                if st.firing and st.oks >= rule.for_windows:
+                    st.firing = False
+                    fire = "resolved"
+        # emit outside the lock: the bus tap re-enters observe_event for
+        # the alert event (ignored by kind, but must not deadlock)
+        if fire is not None:
+            self._transition(rule, key, fire, value, now_info)
+
+    def _transition(
+        self, rule: AlertRule, key, state: str, value: float, now_info: dict
+    ) -> None:
+        payload = {
+            "spec": rule.spec,
+            "metric": rule.metric,
+            "state": state,
+            "value": round(float(value), 6),
+            "threshold": rule.threshold,
+            **now_info,
+        }
+        if key is not None:
+            payload["source"] = key
+        self.transitions.append(payload)
+        if self.bus is not None:
+            self.bus.emit(ALERT_KIND, **payload)
+
+    def observe_event(self, ev: dict) -> None:
+        """One bus event: ``metrics`` flushes (and ``serve`` records'
+        latency deltas) advance every matching window rule."""
+        if not isinstance(ev, dict) or ev.get("kind") not in ("metrics", "serve"):
+            return
+        payload = ev.get("payload") or {}
+        if ev.get("kind") == "serve":
+            hist = payload.get("latency_hist")
+            metrics = {"serve/latency_s": hist} if hist else {}
+        else:
+            metrics = payload.get("metrics") or {}
+        if not metrics:
+            return
+        proc = int(ev.get("process_index", 0))
+        info = {}
+        if ev.get("epoch") is not None:
+            info["epoch"] = ev["epoch"]
+        if ev.get("step") is not None:
+            info["step"] = ev["step"]
+        for i, rule in enumerate(self.rules):
+            if rule.on_heartbeat:
+                continue
+            snap = metrics.get(rule.metric)
+            if snap is None:
+                continue
+            self._observe_value(i, f"p{proc}", rule.value_of(snap), info)
+
+    def tick(self, now: float | None = None) -> None:
+        """Evaluate the heartbeat-age rules against the liveness source
+        (call periodically; the fleet watcher does, once per poll)."""
+        if self.heartbeats is None:
+            return
+        now = time.monotonic() if now is None else now
+        ages = self.heartbeats.ages(now)
+        for i, rule in enumerate(self.rules):
+            if not rule.on_heartbeat:
+                continue
+            for key, age in ages.items():
+                self._observe_value(i, key, float(age), {})
+
+    def states(self) -> dict[str, bool]:
+        """``spec`` → any source currently firing (exporter rendering)."""
+        out: dict[str, bool] = {r.spec: False for r in self.rules}
+        with self._lock:
+            for (idx, _key), st in self._state.items():
+                if st.firing:
+                    out[self.rules[idx].spec] = True
+        return out
+
+    @property
+    def firing(self) -> bool:
+        return any(self.states().values())
+
+
+# ------------------------------------------------- offline (run_report)
+
+
+def alert_timeline(events) -> list[dict]:
+    """The ``alert`` events of a merged stream, in order."""
+    return [
+        ev for ev in events
+        if isinstance(ev, dict) and ev.get("kind") == ALERT_KIND
+    ]
+
+
+def final_states(events) -> dict[tuple[str, object], str]:
+    """``(spec, source)`` → last seen state — the ``--alerts`` exit-code
+    input: any pair still ``firing`` means the run ended unhealthy."""
+    out: dict[tuple[str, object], str] = {}
+    for ev in alert_timeline(events):
+        p = ev.get("payload") or {}
+        out[(p.get("spec", "?"), p.get("source"))] = p.get("state", "?")
+    return out

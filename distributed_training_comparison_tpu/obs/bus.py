@@ -54,6 +54,31 @@ ATTEMPT_ENV = "DTC_ATTEMPT"
 _REQUIRED = ("v", "run_id", "attempt", "process_index", "t_wall", "t_mono", "kind")
 _OPTIONAL = ("epoch", "step", "payload")
 
+# The event-kind registry: every kind any module of this package emits.
+# ``validate_event`` rejects unregistered kinds, so a new emitter that
+# forgets to register (and document — the README kind table is linted by
+# tests/test_fleet.py) fails ``run_report --check`` instead of silently
+# forking the schema.  Embedders emitting their own kinds register them
+# with ``register_kind`` first.
+KNOWN_KINDS = {
+    # trainer lifecycle
+    "run_start", "epoch_start", "epoch_end", "preempt", "abort", "run_end",
+    # health watchdog
+    "skip", "spike", "rollback", "desync",
+    # accounting + gauges
+    "writer", "goodput", "metrics", "serve",
+    # supervisor restart loop
+    "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
+    # live fleet operations (obs/heartbeat, straggler, alerts)
+    "heartbeat", "stall", "straggler", "alert",
+}
+
+
+def register_kind(kind: str) -> str:
+    """Admit an embedder-defined event kind to the schema."""
+    KNOWN_KINDS.add(str(kind))
+    return kind
+
 
 def events_filename(process_index: int = 0) -> str:
     """Per-process event file name: process 0 owns ``events.jsonl``."""
@@ -127,6 +152,7 @@ class EventBus:
         self._broken = False  # sink died (OSError); ring keeps recording
         self._crash_path: Path | None = None  # first dump wins
         self._mmap_ring = None  # durable twin of the in-memory ring
+        self._subscribers: list = []  # live taps (alert engine, exporter)
 
     # -------------------------------------------------------------- emit
 
@@ -169,7 +195,31 @@ class EventBus:
                 self._write(line)
             elif self._persist and not self._broken:
                 self._pending.append(line)
+        # taps run OUTSIDE the emit lock (a subscriber may itself emit —
+        # the in-process alert engine does, on a rule transition) and
+        # behind a blanket except: a live consumer must never kill the
+        # producer it watches
+        for fn in self._subscribers:
+            try:
+                fn(ev)
+            except Exception:
+                pass
         return ev
+
+    def subscribe(self, fn) -> None:
+        """Call ``fn(event)`` on every subsequent emit (in the emitter's
+        thread, outside the bus lock).  Subscribers guarding against
+        their own kinds may emit; exceptions are swallowed."""
+        self._subscribers.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        """Detach a tap installed by ``subscribe`` (no-op if absent) —
+        sessions sharing one process-current bus must not leave stale
+        consumers behind."""
+        try:
+            self._subscribers.remove(fn)
+        except ValueError:
+            pass
 
     def _write(self, line: str) -> None:
         # under self._lock
@@ -423,8 +473,14 @@ def validate_event(ev: object) -> list[str]:
             errs.append(f"field {key!r} has type {type(ev[key]).__name__}")
     if "run_id" in ev and isinstance(ev["run_id"], str) and not ev["run_id"]:
         errs.append("run_id is empty")
-    if "kind" in ev and isinstance(ev["kind"], str) and not ev["kind"]:
-        errs.append("kind is empty")
+    if "kind" in ev and isinstance(ev["kind"], str):
+        if not ev["kind"]:
+            errs.append("kind is empty")
+        elif ev["kind"] not in KNOWN_KINDS:
+            errs.append(
+                f"kind {ev['kind']!r} is not registered "
+                "(obs.bus.KNOWN_KINDS / register_kind)"
+            )
     for key in ("attempt", "process_index"):
         if isinstance(ev.get(key), int) and ev[key] < 0:
             errs.append(f"field {key!r} is negative")

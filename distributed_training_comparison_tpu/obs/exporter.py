@@ -1,0 +1,224 @@
+"""OpenMetrics text exposition of the live registry, heartbeats, alerts.
+
+Everything so far writes *files* — the right durability story for
+post-mortems, the wrong interface for a scraper: Prometheus-compatible
+collectors want an HTTP endpoint with current values, not a jsonl replay.
+This module renders the live state in the `OpenMetrics text format
+<https://prometheus.io/docs/specs/om/open_metrics_spec/>`_ and serves it
+from a stdlib ``http.server`` thread per process (``--metrics-port``;
+0 = off; process *i* listens on ``port + i`` so multi-process hosts don't
+collide):
+
+- the metric registry's **cumulative** view (flushed totals + the pending
+  window), so counters/histograms are monotone the way a scraper expects
+  — histograms expose their log buckets as cumulative ``le`` series;
+- heartbeat ages (``dtc_heartbeat_age_seconds{process="0"}``) from
+  whichever liveness source is wired (the process's own emitter, or the
+  supervisor's fleet tracker);
+- alert states (``dtc_alert_firing{spec="..."}`` 0/1) from the engine.
+
+``render_openmetrics`` is a pure function over plain snapshot dicts, so
+``run_report --export-openmetrics`` produces the identical exposition
+offline from a run's event files — the scrape-less path for batch setups.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .metrics import BPD_DEFAULT
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+PREFIX = "dtc_"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def openmetrics_name(name: str) -> str:
+    """A bus metric name (``serve/latency_s``) as a legal OpenMetrics
+    family name (``dtc_serve_latency_s``)."""
+    base = _NAME_RE.sub("_", str(name))
+    if not base or not (base[0].isalpha() or base[0] in "_:"):
+        base = "_" + base
+    return PREFIX + base
+
+
+def _fmt(value: float) -> str:
+    v = float(value)
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _escape(label_value: str) -> str:
+    return (
+        str(label_value)
+        .replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _histogram_lines(name: str, snap: dict) -> list[str]:
+    """Cumulative ``le`` series from the sparse log-bucket sketch: bucket
+    index k covers (10^(k/bpd), 10^((k+1)/bpd)], so its upper bound is
+    exact; zero/negative samples sit below every bound and therefore
+    count into all of them."""
+    bpd = snap.get("bpd", BPD_DEFAULT)
+    lines = [f"# TYPE {name} histogram"]
+    cum = int(snap.get("zeros", 0))
+    for k in sorted((snap.get("buckets") or {}), key=int):
+        cum += int(snap["buckets"][k])
+        bound = 10.0 ** ((int(k) + 1) / bpd)
+        lines.append(f'{name}_bucket{{le="{bound:.6g}"}} {cum}')
+    count = int(snap.get("count", 0))
+    lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
+    lines.append(f"{name}_count {count}")
+    lines.append(f"{name}_sum {_fmt(snap.get('sum', 0.0))}")
+    return lines
+
+
+def render_openmetrics(
+    metrics: dict[str, dict] | None = None,
+    heartbeat_ages: dict[str, float] | None = None,
+    alert_states: dict[str, bool] | None = None,
+) -> str:
+    """The exposition: one family per metric snapshot (counter → a
+    ``_total`` sample, gauge → plain, histogram → cumulative buckets +
+    count/sum), plus the liveness and alert families.  Always terminated
+    by ``# EOF`` as the spec requires."""
+    lines: list[str] = []
+    for raw_name in sorted(metrics or {}):
+        snap = (metrics or {})[raw_name]
+        if not isinstance(snap, dict):
+            continue
+        name = openmetrics_name(raw_name)
+        kind = snap.get("type")
+        if kind == "counter":
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name}_total {_fmt(snap.get('n', 0))}")
+        elif kind == "gauge":
+            value = snap.get("value")
+            if value is None:
+                continue
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(value)}")
+        elif kind == "histogram":
+            lines.extend(_histogram_lines(name, snap))
+    if heartbeat_ages:
+        name = PREFIX + "heartbeat_age_seconds"
+        lines.append(f"# TYPE {name} gauge")
+        for key in sorted(heartbeat_ages):
+            proc = _escape(str(key).lstrip("p"))
+            lines.append(
+                f'{name}{{process="{proc}"}} {_fmt(heartbeat_ages[key])}'
+            )
+    if alert_states is not None:
+        name = PREFIX + "alert_firing"
+        lines.append(f"# TYPE {name} gauge")
+        for spec in sorted(alert_states):
+            lines.append(
+                f'{name}{{spec="{_escape(spec)}"}} '
+                f"{1 if alert_states[spec] else 0}"
+            )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """The per-process ``/metrics`` endpoint.
+
+    Sources are live objects read at scrape time: ``registry``
+    (``MetricRegistry`` — its cumulative view), ``heartbeats`` (anything
+    with ``ages()``), ``alerts`` (an ``AlertEngine`` — its ``states()``).
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` for the
+    actual one.  The server thread is a daemon and every scrape handles
+    in its own thread, so a slow scraper can neither block training nor
+    block the next scrape.  Never raises out of a scrape — a render
+    error returns 500 with the reason, because an exporter that can take
+    down training is worse than no exporter.
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "",
+        registry=None,
+        heartbeats=None,
+        alerts=None,
+    ) -> None:
+        self.registry = registry
+        self.heartbeats = heartbeats
+        self.alerts = alerts
+        self.scrapes = 0
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = exporter.render().encode("utf-8")
+                except Exception as e:  # render must not kill the server
+                    self.send_error(500, explain=str(e))
+                    return
+                exporter.scrapes += 1
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def render(self) -> str:
+        metrics = (
+            self.registry.cumulative_snapshot()
+            if self.registry is not None
+            else {}
+        )
+        ages = self.heartbeats.ages() if self.heartbeats is not None else None
+        states = self.alerts.states() if self.alerts is not None else None
+        return render_openmetrics(metrics, ages, states)
+
+    def start(self) -> "MetricsExporter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                kwargs={"poll_interval": 0.2},
+                name=f"metrics-exporter:{self.port}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._server.server_close()
+
+
+def start_exporter(
+    port: int, process_index: int = 0, **sources
+) -> MetricsExporter | None:
+    """The flag-level constructor: ``--metrics-port`` semantics (0 = off,
+    process *i* listens on ``port + i``), swallowing bind failures with a
+    None return — a taken port must not kill the run it was meant to
+    watch."""
+    if not port or port <= 0:
+        return None
+    try:
+        # OverflowError: port + process_index past 65535 (a valid base
+        # port on a wide enough host) must degrade like a taken port
+        return MetricsExporter(port=port + process_index, **sources).start()
+    except (OSError, OverflowError):
+        return None

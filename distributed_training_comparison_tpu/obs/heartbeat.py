@@ -1,0 +1,361 @@
+"""Liveness: bounded-cadence heartbeats and supervisor-side stall calls.
+
+Everything the bus records so far is *work* telemetry: an event exists
+because an epoch ended, a checkpoint drained, a rollback fired.  A host
+that stops making progress therefore goes silent — and on a collective
+fabric silence is indistinguishable from slowness until every other host
+wedges inside the next all-reduce waiting for it.  This module adds the
+signal whose absence IS the signal:
+
+- ``HeartbeatEmitter`` — each process emits a tiny ``heartbeat`` event at
+  a bounded cadence (``--heartbeat-secs``, checked at the chunk
+  boundaries the trainer already touches; cost when not due: one clock
+  read).  The payload carries the position (epoch/step ride the
+  envelope) plus the metric-flush sequence number, so a reader can tell
+  "alive but stuck" from "alive and flushing".
+- ``LivenessTracker`` — the watching side (the supervisor, or any
+  ``run_report --follow`` consumer) folds heartbeats per process and
+  classifies a lagging host as **slow** (heartbeats stale past
+  ``slow_after_s``) or **dead** (stale past ``dead_after_s``), emitting
+  one ``stall`` event per state *transition* — before the collective
+  wedges, and without flapping while a state persists.  Ages are
+  measured from the *observer's* clock at the moment the heartbeat was
+  read, so cross-host wall-clock skew cannot fake a stall.
+- ``EventTailer`` — the incremental reader the supervisor's fleet watcher
+  polls: byte offsets per ``events*.jsonl`` under the checkpoint root,
+  new attempts'/hosts' files picked up as they appear, torn trailing
+  lines left for the next poll (the same contract as ``run_report
+  --follow``, importable from the package).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+
+HEARTBEAT_KIND = "heartbeat"
+STALL_KIND = "stall"
+
+# Kinds that do NOT prove a training process alive: they originate from
+# the WATCHING side (the supervisor's restart loop and the live-ops plane
+# itself).  Counting them would make liveness self-referential — the
+# supervisor's own `stall` emission lands in the tailed root file as a
+# process-0 event and would "revive" the very host it just called out,
+# flapping slow→recovered forever.
+_NON_LIVENESS_KINDS = {
+    STALL_KIND, "straggler", "alert",
+    "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
+}
+
+# liveness thresholds as multiples of the heartbeat cadence: a beat is
+# expected every interval, so "slow" = a few missed beats, "dead" = an
+# order of magnitude of silence
+SLOW_AFTER_BEATS = 3.0
+DEAD_AFTER_BEATS = 10.0
+
+
+class HeartbeatEmitter:
+    """One process's bounded-cadence ``heartbeat`` emitter.
+
+    ``beat`` is called wherever the trainer already touches the host
+    between dispatches (chunk boundaries, epoch edges); it emits at most
+    one event per ``every_s`` seconds — the cadence bound, not the call
+    rate, is the contract.  ``every_s <= 0`` disables emission entirely
+    (``ages`` still tracks the last call, so an exporter shows liveness
+    even when the bus stream carries no beats).
+    """
+
+    def __init__(self, bus, every_s: float = 10.0) -> None:
+        self.bus = bus
+        self.every_s = float(every_s)
+        self._lock = threading.Lock()
+        self._last_emit = -float("inf")
+        self._last_call: float | None = None
+        self.emitted = 0
+
+    def beat(
+        self,
+        *,
+        epoch: int | None = None,
+        step: int | None = None,
+        flush_seq: int | None = None,
+        force: bool = False,
+        **payload,
+    ) -> dict | None:
+        """Emit a ``heartbeat`` if the cadence allows (or ``force``);
+        returns the event or None when rate-limited/disabled."""
+        now = time.monotonic()
+        with self._lock:
+            self._last_call = now
+            if not force:
+                if self.every_s <= 0:
+                    return None
+                if now - self._last_emit < self.every_s:
+                    return None
+            self._last_emit = now
+            self.emitted += 1
+        body = dict(payload)
+        if flush_seq is not None:
+            body["flush_seq"] = int(flush_seq)
+        return self.bus.emit(HEARTBEAT_KIND, epoch=epoch, step=step, **body)
+
+    def ages(self, now: float | None = None) -> dict[str, float]:
+        """``{"p{i}": seconds since the last beat() call}`` — the
+        exporter's self-liveness gauge (call age, not emit age: a
+        rate-limited process is still alive)."""
+        with self._lock:
+            last = self._last_call
+        if last is None:
+            return {}
+        now = time.monotonic() if now is None else now
+        return {f"p{self.bus.process_index}": max(0.0, now - last)}
+
+
+class LivenessTracker:
+    """Fold observed heartbeats per process; classify slow vs dead.
+
+    ``observe(event, now)`` records the observer-clock arrival time of
+    every ``heartbeat`` (other *training-side* kinds also refresh
+    liveness — a host emitting ``epoch_end`` is self-evidently alive;
+    watcher-side kinds are excluded, see ``_NON_LIVENESS_KINDS``).
+    ``check(now)`` returns the state *transitions* since the last
+    check::
+
+        [{"process_index": 1, "attempt": 0, "state": "slow",
+          "age_s": 31.2, "epoch": 3, "step": 120,
+          "behind_steps": 40}, ...]
+
+    states: ``ok`` → ``slow`` (age > ``slow_after_s``) → ``dead``
+    (age > ``dead_after_s``), and back to ``ok`` on the next sign of
+    life (reported as state ``recovered``).  One dict per transition —
+    a host stuck in ``slow`` produces nothing until it worsens or
+    recovers, so the emitted ``stall`` stream never flaps.
+    """
+
+    def __init__(
+        self, heartbeat_s: float = 10.0,
+        slow_after_s: float | None = None,
+        dead_after_s: float | None = None,
+    ) -> None:
+        interval = max(float(heartbeat_s), 1e-9)
+        self.slow_after_s = (
+            float(slow_after_s) if slow_after_s is not None
+            else SLOW_AFTER_BEATS * interval
+        )
+        self.dead_after_s = (
+            float(dead_after_s) if dead_after_s is not None
+            else DEAD_AFTER_BEATS * interval
+        )
+        # process -> {"last_seen", "state", "epoch", "step", "attempt"}
+        self._procs: dict[int, dict] = {}
+
+    def reset(self) -> None:
+        """Forget every tracked process (between supervised attempts: the
+        backoff gap must not read as the whole fleet dying)."""
+        self._procs.clear()
+
+    def observe(self, ev: dict, now: float | None = None) -> None:
+        if not isinstance(ev, dict):
+            return
+        kind = ev.get("kind")
+        if kind in _NON_LIVENESS_KINDS:
+            return
+        p = int(ev.get("process_index", 0))
+        now = time.monotonic() if now is None else now
+        rec = self._procs.setdefault(
+            p, {"last_seen": now, "state": "ok", "epoch": None, "step": None,
+                "attempt": int(ev.get("attempt", 0)), "beats": 0}
+        )
+        rec["last_seen"] = now
+        rec["attempt"] = int(ev.get("attempt", rec["attempt"] or 0))
+        if kind == HEARTBEAT_KIND:
+            rec["beats"] += 1
+            if "epoch" in ev:
+                rec["epoch"] = ev["epoch"]
+            if "step" in ev:
+                rec["step"] = ev["step"]
+
+    def ages(self, now: float | None = None) -> dict[str, float]:
+        now = time.monotonic() if now is None else now
+        return {
+            f"p{p}": max(0.0, now - rec["last_seen"])
+            for p, rec in sorted(self._procs.items())
+        }
+
+    def states(self) -> dict[int, str]:
+        return {p: rec["state"] for p, rec in self._procs.items()}
+
+    def check(self, now: float | None = None) -> list[dict]:
+        """Classify every tracked process; return the transitions."""
+        now = time.monotonic() if now is None else now
+        fleet_step = max(
+            (rec["step"] for rec in self._procs.values()
+             if rec["step"] is not None),
+            default=None,
+        )
+        out = []
+        for p, rec in sorted(self._procs.items()):
+            age = now - rec["last_seen"]
+            if age > self.dead_after_s:
+                state = "dead"
+            elif age > self.slow_after_s:
+                state = "slow"
+            else:
+                state = "ok"
+            if state == "dead" and not rec["beats"]:
+                # before the FIRST heartbeat the silence is usually the
+                # first dispatch's jit compile (minutes on TPU) — stay at
+                # "slow" rather than paging "dead" at the start of every
+                # attempt; once a process has ever beaten, full silence
+                # escalates normally
+                state = "slow"
+            if state == rec["state"]:
+                continue
+            recovered = state == "ok"
+            rec["state"] = state
+            finding = {
+                "process_index": p,
+                "attempt": rec["attempt"],
+                "state": "recovered" if recovered else state,
+                "age_s": round(max(0.0, age), 3),
+            }
+            if rec["epoch"] is not None:
+                finding["epoch"] = rec["epoch"]
+            if rec["step"] is not None:
+                finding["step"] = rec["step"]
+                if fleet_step is not None:
+                    finding["behind_steps"] = int(fleet_step - rec["step"])
+            out.append(finding)
+        return out
+
+
+class EventTailer:
+    """Incremental reader of every ``events*.jsonl`` under a ckpt root.
+
+    Same contract as ``run_report --follow``: per-file byte offsets, new
+    files (new attempts, new hosts) picked up on every poll, a torn
+    trailing line buffered until its writer completes it.  ``poll()``
+    returns the new events, wall-clock ordered.
+    """
+
+    def __init__(self, root: str | Path) -> None:
+        self.root = Path(root)
+        self._offsets: dict[Path, int] = {}
+
+    def _files(self) -> list[Path]:
+        if self.root.is_file():
+            return [self.root]
+        return sorted(self.root.glob("events*.jsonl")) + sorted(
+            self.root.glob("version-*/events*.jsonl")
+        )
+
+    def poll(self) -> list[dict]:
+        batch: list[dict] = []
+        for f in self._files():
+            pos = self._offsets.get(f, 0)
+            try:
+                with open(f, "rb") as fh:
+                    fh.seek(pos)
+                    chunk = fh.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            keep = chunk.rfind(b"\n") + 1
+            if keep == 0:
+                continue
+            self._offsets[f] = pos + keep
+            for line in chunk[:keep].splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    batch.append(json.loads(line))
+                except ValueError:
+                    continue
+        batch.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)))
+        return batch
+
+
+class FleetWatcher:
+    """The supervisor's live eye: a thread tailing the fleet's event files
+    while an attempt runs, feeding the liveness tracker and the alert
+    engine, and emitting ``stall`` / ``alert`` events on the supervisor's
+    own bus — the operations loop that exists *outside* the training
+    processes, so a wedged collective cannot take its own monitoring down
+    with it.
+
+    ``tracker`` / ``engine`` are optional: a watcher with neither still
+    tails (e.g. to keep the exporter's fleet state fresh).  ``start`` /
+    ``stop`` bracket one supervised run; ``step()`` runs one poll cycle
+    synchronously (tests drive it with a fake clock).
+    """
+
+    def __init__(
+        self,
+        root: str | Path,
+        bus,
+        tracker: LivenessTracker | None = None,
+        engine=None,
+        poll_s: float = 1.0,
+    ) -> None:
+        self.tailer = EventTailer(root)
+        self.bus = bus
+        self.tracker = tracker
+        self.engine = engine
+        self.poll_s = float(poll_s)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def step(self, now: float | None = None) -> list[dict]:
+        """One poll cycle; returns the events it consumed."""
+        now = time.monotonic() if now is None else now
+        batch = self.tailer.poll()
+        for ev in batch:
+            if self.tracker is not None:
+                self.tracker.observe(ev, now=now)
+            if self.engine is not None:
+                self.engine.observe_event(ev)
+        if self.tracker is not None:
+            for finding in self.tracker.check(now=now):
+                self.bus.emit(
+                    STALL_KIND,
+                    epoch=finding.pop("epoch", None),
+                    step=finding.pop("step", None),
+                    **finding,
+                )
+        if self.engine is not None:
+            self.engine.tick(now=now)
+        return batch
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # watching must never kill supervising
+                pass
+            self._stop.wait(self.poll_s)
+
+    def start(self) -> "FleetWatcher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="fleet-watcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if drain:
+            # one final synchronous sweep so events written in the last
+            # poll interval (the attempt's closing flush) are not lost
+            try:
+                self.step()
+            except Exception:
+                pass

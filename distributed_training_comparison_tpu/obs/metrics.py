@@ -305,6 +305,14 @@ class MetricRegistry:
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
         self._steps_since_flush = 0
         self.flushes = 0
+        # run-so-far totals, folded at every flush: the OpenMetrics
+        # exporter renders cumulative + pending, so a scrape between
+        # flushes still sees monotone counters/histograms.  _fold_lock
+        # makes reset-then-fold atomic against a concurrent scrape — a
+        # scrape landing between the two would see the window in NEITHER
+        # term, a counter dip Prometheus reads as a reset.
+        self._cumulative: dict[str, dict] = {}
+        self._fold_lock = threading.Lock()
 
     def _get(self, name: str, cls):
         with self._lock:
@@ -351,7 +359,12 @@ class MetricRegistry:
         with self._lock:
             steps = self._steps_since_flush
             self._steps_since_flush = 0
-        snaps = self.snapshot(reset=True)
+        with self._fold_lock:
+            snaps = self.snapshot(reset=True)
+            if snaps:
+                self._cumulative = merge_metric_events(
+                    [{"metrics": self._cumulative}, {"metrics": snaps}]
+                )
         if not snaps:
             return None
         self.flushes += 1
@@ -360,12 +373,31 @@ class MetricRegistry:
             metrics=snaps, steps=steps,
         )
 
+    def flush_due(self) -> bool:
+        """Has the per-step budget accumulated?  Lets a caller run
+        pre-flush work (e.g. the resource gauges) only on windows that
+        will actually emit."""
+        with self._lock:
+            return self._steps_since_flush >= self.flush_steps
+
     def maybe_flush(
         self, bus, *, epoch: int | None = None, step: int | None = None
     ):
         """``flush`` only if the per-step budget has accumulated — the
         call every chunk boundary makes; cost when not due: one lock."""
-        with self._lock:
-            if self._steps_since_flush < self.flush_steps:
-                return None
+        if not self.flush_due():
+            return None
         return self.flush(bus, epoch=epoch, step=step)
+
+    def cumulative_snapshot(self) -> dict:
+        """Run-so-far totals: everything flushed, merged with the pending
+        (unflushed) window — counters/histograms monotone across the run,
+        gauges latest-wins.  Non-destructive; the exporter's scrape view
+        (serialized against flush's reset-then-fold, see ``_fold_lock``).
+        """
+        with self._fold_lock:
+            pending = self.snapshot(reset=False)
+            cumulative = self._cumulative
+        return merge_metric_events(
+            [{"metrics": cumulative}, {"metrics": pending}]
+        )

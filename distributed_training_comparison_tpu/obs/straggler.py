@@ -1,0 +1,198 @@
+"""Cross-host straggler attribution from the per-step phase sketches.
+
+A fleet that runs collectives moves at the pace of its slowest member,
+and the ``StepTimeMeter`` breakdown every process already flushes
+(``step/{h2d_wait,dispatch,compute}_s`` histogram sketches, one sample
+per chunk) contains exactly the evidence of who that member is — it just
+lives in N per-host event files nobody cross-reads.  This module does the
+cross-read:
+
+- group every ``metrics`` flush by ``(attempt, process)`` and merge each
+  host's phase sketches (the associative merge the sketch format
+  guarantees — order and flush boundaries don't matter);
+- score each host's **p95** for each phase against the *other* hosts'
+  p95s with the same robust scheme as ``health/spike.py``: median + MAD
+  with a median-relative floor.  The baseline is leave-one-out — with a
+  fleet of two, a symmetric baseline would put the straggler inside its
+  own yardstick and never flag it;
+- report findings naming **host + phase** (and the flush windows when
+  per-window resolution is requested), which the supervisor emits as
+  ``straggler`` events and ``run_report`` renders as a per-host table.
+
+Single-host runs and phases below ``min_samples`` produce no findings —
+attribution needs a fleet and a distribution, not a guess.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from .metrics import histogram_quantile, merge_histograms
+
+STRAGGLER_KIND = "straggler"
+
+# the phase sketches utils/meters.py flushes, one sample per chunk
+STEP_PHASES = ("h2d_wait", "dispatch", "compute")
+PHASE_METRICS = {f"step/{p}_s": p for p in STEP_PHASES}
+
+# same robustness idea as health/spike.py, tuned for timing data: chunk
+# wall-times are noisier than losses, so the MAD floor is a larger
+# fraction of the median
+THRESHOLD_MADS_DEFAULT = 6.0
+_MAD_FLOOR_FRAC = 0.25
+_MAD_FLOOR_ABS = 1e-6
+MIN_SAMPLES_DEFAULT = 3
+
+
+def _median(vals: list[float]) -> float:
+    vals = sorted(vals)
+    mid = len(vals) // 2
+    return vals[mid] if len(vals) % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def _score(value: float, baseline: list[float]) -> tuple[float, float]:
+    """(score in MADs above the baseline median, that median)."""
+    med = _median(baseline)
+    mad = _median([abs(b - med) for b in baseline])
+    mad = max(mad, _MAD_FLOOR_ABS, _MAD_FLOOR_FRAC * abs(med))
+    return (value - med) / mad, med
+
+
+def merge_phase_sketches(events) -> dict[tuple[int, int], dict[str, dict]]:
+    """``(attempt, process) -> {phase: merged histogram snapshot}`` from a
+    run's ``metrics`` events.  Accepts the full merged event list — other
+    kinds pass through untouched."""
+    out: dict[tuple[int, int], dict[str, dict]] = defaultdict(dict)
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "metrics":
+            continue
+        key = (int(ev.get("attempt", 0)), int(ev.get("process_index", 0)))
+        metrics = (ev.get("payload") or {}).get("metrics") or {}
+        for name, snap in metrics.items():
+            phase = PHASE_METRICS.get(name)
+            if phase is None or not isinstance(snap, dict):
+                continue
+            out[key][phase] = merge_histograms(out[key].get(phase), snap)
+    return out
+
+
+def host_phase_table(
+    events, q: float = 0.95
+) -> dict[int, dict[int, dict[str, dict]]]:
+    """``attempt -> process -> phase -> {"p95_s", "count", "mean_s"}`` —
+    the per-host table ``run_report`` renders (quantile configurable,
+    p95 by default)."""
+    table: dict[int, dict[int, dict[str, dict]]] = defaultdict(
+        lambda: defaultdict(dict)
+    )
+    for (attempt, proc), phases in merge_phase_sketches(events).items():
+        for phase, snap in phases.items():
+            if not snap or not snap.get("count"):
+                continue
+            table[attempt][proc][phase] = {
+                "p95_s": histogram_quantile(snap, q),
+                "count": snap["count"],
+                "mean_s": snap.get("sum", 0.0) / snap["count"],
+            }
+    return table
+
+
+def straggler_findings(
+    events,
+    threshold_mads: float = THRESHOLD_MADS_DEFAULT,
+    min_samples: int = MIN_SAMPLES_DEFAULT,
+    q: float = 0.95,
+) -> list[dict]:
+    """Score every (attempt, host, phase) p95 against the rest of the
+    fleet; return the findings that clear ``threshold_mads``::
+
+        {"attempt": 0, "process_index": 1, "phase": "dispatch",
+         "p95_s": 0.51, "fleet_p95_s": 0.102, "score_mads": 48.3,
+         "hosts": 2, "samples": 40}
+
+    Sorted worst-first.  Needs >= 2 hosts reporting the phase and
+    ``min_samples`` sketch samples per host — below either, no finding.
+    """
+    sketches = merge_phase_sketches(events)
+    by_attempt: dict[int, dict[str, dict[int, dict]]] = defaultdict(
+        lambda: defaultdict(dict)
+    )
+    for (attempt, proc), phases in sketches.items():
+        for phase, snap in phases.items():
+            if snap and snap.get("count", 0) >= min_samples:
+                by_attempt[attempt][phase][proc] = snap
+    findings: list[dict] = []
+    for attempt, phases in by_attempt.items():
+        for phase, per_host in phases.items():
+            if len(per_host) < 2:
+                continue
+            p95s = {
+                p: histogram_quantile(snap, q) for p, snap in per_host.items()
+            }
+            for proc, p95 in p95s.items():
+                baseline = [v for pp, v in p95s.items() if pp != proc]
+                score, fleet = _score(p95, baseline)
+                if score < threshold_mads:
+                    continue
+                findings.append(
+                    {
+                        "attempt": attempt,
+                        "process_index": proc,
+                        "phase": phase,
+                        "p95_s": round(p95, 6),
+                        "fleet_p95_s": round(fleet, 6),
+                        "score_mads": round(score, 2),
+                        "hosts": len(per_host),
+                        "samples": per_host[proc].get("count", 0),
+                    }
+                )
+    findings.sort(key=lambda f: -f["score_mads"])
+    return findings
+
+
+def emit_straggler_events(bus, events, **kwargs) -> list[dict]:
+    """Run attribution over ``events`` and emit one ``straggler`` event
+    per finding on ``bus`` (the supervisor's post-attempt call).  Returns
+    the findings."""
+    findings = straggler_findings(events, **kwargs)
+    for f in findings:
+        bus.emit(STRAGGLER_KIND, **f)
+    return findings
+
+
+def format_table(events) -> list[str]:
+    """The per-host phase table as report lines (empty when the stream
+    carries no per-host phase sketches)."""
+    table = host_phase_table(events)
+    if not table:
+        return []
+    flagged = {
+        (f["attempt"], f["process_index"], f["phase"]): f["score_mads"]
+        for f in straggler_findings(events)
+    }
+    lines = ["  per-host step phases (p95 seconds; * = straggler):"]
+    header = f"    {'attempt':>7} {'proc':>4}" + "".join(
+        f" {p:>12}" for p in STEP_PHASES
+    )
+    lines.append(header)
+    for attempt in sorted(table):
+        for proc in sorted(table[attempt]):
+            cells = []
+            for phase in STEP_PHASES:
+                cell = table[attempt][proc].get(phase)
+                if cell is None:
+                    cells.append(f" {'-':>12}")
+                    continue
+                mark = (
+                    "*" if (attempt, proc, phase) in flagged else " "
+                )
+                cells.append(f" {cell['p95_s']:>11.4g}{mark}")
+            lines.append(f"    {attempt:>7} {proc:>4}" + "".join(cells))
+    for (attempt, proc, phase), score in sorted(
+        flagged.items(), key=lambda kv: -kv[1]
+    ):
+        lines.append(
+            f"    straggler: attempt {attempt} process {proc} "
+            f"phase {phase} ({score:.1f} MADs above the fleet)"
+        )
+    return lines

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import struct
 from pathlib import Path
 
 # XSpace wire schema (tensorflow/compiler/xla/tsl/profiler/protobuf/xplane.proto)
@@ -74,6 +75,20 @@ def _group(buf: bytes) -> dict[int, list]:
     return out
 
 
+def _iter_plane_bytes(data: bytes, warn=None):
+    """The raw bytes of every XSpace ``planes=1`` entry.  A wire-level
+    failure (unknown wire type from a future proto, truncation) stops the
+    walk but yields every plane already seen — partial decode beats an
+    empty merge."""
+    try:
+        for fnum, _, val in _fields(data):
+            if fnum == 1:
+                yield val
+    except (ValueError, IndexError, TypeError) as e:
+        if warn is not None:
+            warn(f"xplane wire decode stopped early: {e}")
+
+
 def _metadata_map(entries: list[bytes]) -> dict[int, str]:
     """map<int64, X*Metadata> → id → name."""
     out: dict[int, str] = {}
@@ -92,8 +107,6 @@ def _stat_value(stat: dict[int, list], stat_names: dict[int, str]):
         if fnum in stat:
             return stat[fnum][0]
     if 2 in stat:  # double, fixed64
-        import struct
-
         return struct.unpack("<d", stat[2][0])[0]
     for fnum in (5, 6):  # str, bytes
         if fnum in stat:
@@ -103,54 +116,67 @@ def _stat_value(stat: dict[int, list], stat_names: dict[int, str]):
     return None
 
 
-def parse_xplane(path: str | Path) -> list[dict]:
+def parse_xplane(path: str | Path, warn=None) -> list[dict]:
     """An ``.xplane.pb`` file → plane dicts::
 
         {"name": str, "lines": [{"name": str, "timestamp_ns": int,
           "events": [{"name": str, "ts_us": float, "dur_us": float,
                       "stats": {...}}]}]}
+
+    Decode damage is contained per plane: a plane whose wire bytes don't
+    parse (a future proto revision, a torn capture) is skipped with a
+    ``warn(msg)`` call instead of voiding the planes already decoded —
+    the device lanes a real TPU capture carries must survive an unknown
+    sibling.  Plane *names* are never interpreted here, so renamed
+    device planes pass through as lane labels untouched.
     """
     data = Path(path).read_bytes()
     planes = []
-    for fnum, _, val in _fields(data):
-        if fnum != 1:
-            continue
-        p = _group(val)
-        event_names = _metadata_map(p.get(4, []))
-        stat_names = _metadata_map(p.get(5, []))
-        lines = []
-        for raw_line in p.get(3, []):
-            ln = _group(raw_line)
-            ts_ns = int(ln.get(3, [0])[0])
-            events = []
-            for raw_ev in ln.get(4, []):
-                ev = _group(raw_ev)
-                stats = {}
-                for raw_stat in ev.get(4, []):
-                    st = _group(raw_stat)
-                    key = stat_names.get(int(st.get(1, [0])[0]))
-                    if key:
-                        stats[key] = _stat_value(st, stat_names)
-                events.append(
+    for raw in _iter_plane_bytes(data, warn):
+        try:
+            p = _group(raw)
+            plane_name = p.get(2, [b""])[0].decode("utf-8", "replace")
+            event_names = _metadata_map(p.get(4, []))
+            stat_names = _metadata_map(p.get(5, []))
+            lines = []
+            for raw_line in p.get(3, []):
+                ln = _group(raw_line)
+                ts_ns = int(ln.get(3, [0])[0])
+                events = []
+                for raw_ev in ln.get(4, []):
+                    ev = _group(raw_ev)
+                    stats = {}
+                    for raw_stat in ev.get(4, []):
+                        st = _group(raw_stat)
+                        key = stat_names.get(int(st.get(1, [0])[0]))
+                        if key:
+                            stats[key] = _stat_value(st, stat_names)
+                    events.append(
+                        {
+                            "name": event_names.get(
+                                int(ev.get(1, [0])[0]), "?"
+                            ),
+                            "ts_us": ts_ns / 1e3 + int(ev.get(2, [0])[0]) / 1e6,
+                            "dur_us": int(ev.get(3, [0])[0]) / 1e6,
+                            "stats": stats,
+                        }
+                    )
+                lines.append(
                     {
-                        "name": event_names.get(
-                            int(ev.get(1, [0])[0]), "?"
-                        ),
-                        "ts_us": ts_ns / 1e3 + int(ev.get(2, [0])[0]) / 1e6,
-                        "dur_us": int(ev.get(3, [0])[0]) / 1e6,
-                        "stats": stats,
+                        "name": ln.get(2, [b""])[0].decode("utf-8", "replace"),
+                        "timestamp_ns": ts_ns,
+                        "events": events,
                     }
                 )
-            lines.append(
-                {
-                    "name": ln.get(2, [b""])[0].decode("utf-8", "replace"),
-                    "timestamp_ns": ts_ns,
-                    "events": events,
-                }
-            )
-        planes.append(
-            {"name": p.get(2, [b""])[0].decode("utf-8", "replace"), "lines": lines}
-        )
+        except (ValueError, IndexError, struct.error, TypeError,
+                AttributeError):
+            # TypeError/AttributeError: wire damage can put a varint where
+            # bytes were expected (an int has no .decode) — contain it
+            # like any other undecodable plane
+            if warn is not None:
+                warn(f"{path}: skipped one undecodable plane")
+            continue
+        planes.append({"name": plane_name, "lines": lines})
     return planes
 
 
@@ -262,13 +288,24 @@ def _median(vals: list[float]) -> float:
     return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
 
 
-def load_profiler_chrome_events(profile_dir: str | Path) -> list[dict]:
+def load_profiler_chrome_events(
+    profile_dir: str | Path, warn=None
+) -> list[dict]:
     """All device/host profiler events under a capture dir as Chrome
     events: xplane protobufs when present, the profiler's own trace.json
-    artifacts otherwise."""
+    artifacts otherwise.  An unreadable xplane file degrades to a
+    ``warn(msg)`` call and whatever its siblings decoded — never an
+    exception, never a silently empty merge."""
     events: list[dict] = []
     for i, pb in enumerate(find_xplanes(profile_dir)):
-        planes = parse_xplane(pb)
+        try:
+            planes = parse_xplane(pb, warn=warn)
+        except OSError as e:
+            if warn is not None:
+                warn(f"skipping unreadable xplane {pb}: {e}")
+            continue
+        if not planes and warn is not None:
+            warn(f"{pb}: no decodable planes")
         events.extend(
             planes_to_chrome(
                 planes, pid_base=1000 + 100 * i, name_filter=default_name_filter

@@ -268,14 +268,70 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
 
         return resume_progress_marker(hparams.ckpt_path)
 
+    # --- the live operations plane (obs/): while an attempt runs, a
+    # watcher thread tails every host's event file under the ckpt root,
+    # classifies lagging hosts slow vs dead off their heartbeats (`stall`
+    # events land on the supervisor's own bus — the one place a wedged
+    # collective can't take down), and evaluates the --alert rules over
+    # the flushed metric events and heartbeat ages.
+    # --heartbeat-secs 0 disables heartbeats AND stall detection (with no
+    # beats, ordinary work-event gaps would read as the fleet dying); the
+    # watcher still runs for the --alert rules.
+    heartbeat_s = getattr(hparams, "heartbeat_secs", 10.0)
+    tracker = (
+        obs.LivenessTracker(heartbeat_s=heartbeat_s)
+        if heartbeat_s and heartbeat_s > 0
+        else None
+    )
+    engine = obs.AlertEngine(
+        obs.parse_alert_specs(getattr(hparams, "alert", None)),
+        bus=bus,
+        heartbeats=tracker,
+    )
+    watcher = (
+        obs.FleetWatcher(hparams.ckpt_path, bus, tracker=tracker, engine=engine)
+        if obs_enabled
+        else None
+    )
+    emitted_stragglers: set[tuple] = set()
+    # attribution input, accumulated INCREMENTALLY: one persistent tailer
+    # plus a metrics-only buffer, so attempt N's pass doesn't re-read and
+    # re-parse every prior attempt's whole event history (O(N^2) on long
+    # gauntlets).  Separate from the watcher's tailer — that one feeds
+    # the live tracker/engine on its own thread.
+    straggler_tailer = obs.EventTailer(hparams.ckpt_path)
+    metric_events: list[dict] = []
+
     def on_event(kind: str, **payload):
         bus.emit(kind, **payload)
+        if kind == "attempt_start" and tracker is not None:
+            # fresh liveness per attempt: the previous attempt's death and
+            # the backoff gap must not read as this one's fleet stalling
+            tracker.reset()
         if kind == "attempt_end" and obs_enabled:
             # the black-box pull: decode every host's mmap flight ring
             # under the ckpt root (version dirs included) into ONE
             # blackbox.json — present even when the attempt died by
             # SIGKILL/OOM and no process lived to write its crash dump
             obs.collect_black_box(hparams.ckpt_path)
+            # cross-host straggler attribution: merge every host's
+            # step-phase sketches and name host + phase for any outlier
+            # (one event per NEW finding — re-reading the whole root on a
+            # later attempt must not re-emit an earlier one)
+            try:
+                metric_events.extend(
+                    ev for ev in straggler_tailer.poll()
+                    if ev.get("kind") == "metrics"
+                )
+                for f in obs.straggler_findings(metric_events):
+                    key = (f["attempt"], f["process_index"], f["phase"])
+                    if key not in emitted_stragglers:
+                        emitted_stragglers.add(key)
+                        bus.emit(obs.STRAGGLER_KIND, **f)
+            except Exception:  # attribution must never kill supervising
+                pass
+            if tracker is not None:
+                tracker.reset()
 
     sup = Supervisor(
         cmd_for,
@@ -286,7 +342,13 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
         events=on_event,
     )
     t_start = time.time()
-    summary = sup.run()
+    if watcher is not None:
+        watcher.start()
+    try:
+        summary = sup.run()
+    finally:
+        if watcher is not None:
+            watcher.stop()
 
     # aggregate the per-attempt goodput records the children appended —
     # across ALL version dirs (an attempt that died pre-first-save leaves

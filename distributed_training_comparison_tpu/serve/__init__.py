@@ -165,32 +165,58 @@ def serve_main(hparams) -> dict:
     if getattr(hparams, "obs", True):
         bus = obs.current_bus()
         bus.bind_dir(hparams.ckpt_path)
-    metrics = ServeMetrics(bus=bus)
+    # live operations for the serving path: the latency histogram and
+    # queue/shed gauges mirror into a metric registry the OpenMetrics
+    # endpoint renders (--metrics-port), and the --alert rules evaluate
+    # in-process over the periodic `metrics` emits (serving runs
+    # unsupervised, so there is no fleet watcher to do it).
+    registry = obs.MetricRegistry()
+    alert_engine = None
+    specs = getattr(hparams, "alert", None)
+    if specs and bus is not None:
+        alert_engine = obs.AlertEngine(obs.parse_alert_specs(specs), bus=bus)
+        bus.subscribe(alert_engine.observe_event)
+    exporter = obs.start_exporter(
+        getattr(hparams, "metrics_port", 0),
+        registry=registry,
+        alerts=alert_engine,
+    )
+    if exporter is not None:
+        logger.info(f"[serve] OpenMetrics endpoint on :{exporter.port}/metrics")
+    metrics = ServeMetrics(bus=bus, registry=registry)
     deadline = getattr(hparams, "deadline_ms", 0.0) or None
-    with MicroBatcher(
-        engine,
-        max_wait_ms=hparams.max_wait_ms,
-        queue_limit=hparams.queue_limit,
-        metrics=metrics,
-    ) as batcher:
-        rate = getattr(hparams, "serve_rate", 0.0)
-        if rate > 0:
-            report = open_loop(
-                batcher,
-                images,
-                rate_rps=rate,
-                num_requests=hparams.serve_requests,
-                deadline_ms=deadline,
-                seed=hparams.seed,
-            )
-        else:
-            report = closed_loop(
-                batcher,
-                images,
-                num_requests=hparams.serve_requests,
-                concurrency=hparams.serve_concurrency,
-                deadline_ms=deadline,
-            )
+    try:
+        with MicroBatcher(
+            engine,
+            max_wait_ms=hparams.max_wait_ms,
+            queue_limit=hparams.queue_limit,
+            metrics=metrics,
+        ) as batcher:
+            rate = getattr(hparams, "serve_rate", 0.0)
+            if rate > 0:
+                report = open_loop(
+                    batcher,
+                    images,
+                    rate_rps=rate,
+                    num_requests=hparams.serve_requests,
+                    deadline_ms=deadline,
+                    seed=hparams.seed,
+                )
+            else:
+                report = closed_loop(
+                    batcher,
+                    images,
+                    num_requests=hparams.serve_requests,
+                    concurrency=hparams.serve_concurrency,
+                    deadline_ms=deadline,
+                )
+    finally:
+        # an aborted session must not leak the listening /metrics port or
+        # leave a stale rule engine tapping the process-current bus
+        if exporter is not None:
+            exporter.close()
+        if alert_engine is not None and bus is not None:
+            bus.unsubscribe(alert_engine.observe_event)
     metrics.log_summary(logger)
     report["engine"] = engine.stats()
     if is_main_process():

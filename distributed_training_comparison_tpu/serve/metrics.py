@@ -101,7 +101,8 @@ class ServeMetrics:
     """
 
     def __init__(
-        self, bus=None, emit_every_s: float = EMIT_EVERY_S_DEFAULT
+        self, bus=None, emit_every_s: float = EMIT_EVERY_S_DEFAULT,
+        registry=None,
     ) -> None:
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
@@ -118,6 +119,16 @@ class ServeMetrics:
         # the associatively-mergeable sketch the bus events carry; the
         # reservoir serves the exact-ish in-process summary() instead
         self._latency_hist = Histogram("serve/latency_s")
+        # optional process metric registry (obs/metrics.py): latency +
+        # queue/shed gauges mirror into it so the OpenMetrics exporter
+        # (--metrics-port) renders the serving session live.  Separate
+        # instances from the bus sketch — the periodic emit resets ITS
+        # delta, the registry keeps the cumulative view a scraper expects.
+        self._reg_latency = (
+            registry.histogram("serve/latency_s") if registry is not None
+            else None
+        )
+        self._registry = registry
 
     # back-compat views: callers/tests read the raw sample lists by name
     @property
@@ -138,16 +149,23 @@ class ServeMetrics:
             self.completed += 1
             self._latencies.add(latency_s)
         self._latency_hist.record(latency_s)
+        if self._reg_latency is not None:
+            self._reg_latency.record(latency_s)
+            self._registry.gauge("serve/completed").set(self.completed)
         self._maybe_emit_metrics()
 
     def record_batch(self, batch_size: int, queue_depth: int) -> None:
         with self._lock:
             self._batch_sizes.add(int(batch_size))
             self._queue_depths.add(int(queue_depth))
+        if self._registry is not None:
+            self._registry.gauge("serve/queue_depth").set(int(queue_depth))
 
     def record_shed(self) -> None:
         with self._lock:
             self.shed += 1
+        if self._registry is not None:
+            self._registry.gauge("serve/shed").set(self.shed)
 
     def record_expired(self) -> None:
         with self._lock:

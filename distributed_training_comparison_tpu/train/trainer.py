@@ -630,6 +630,57 @@ class Trainer:
         self.metrics = obs.MetricRegistry(
             flush_steps=getattr(hparams, "metrics_flush_steps", 50)
         )
+        # --- live fleet operations (obs/): bounded-cadence heartbeats
+        # (liveness the supervisor's watcher classifies slow vs dead),
+        # resource gauges sampled once per flush, an optional per-process
+        # OpenMetrics endpoint, and — for UNSUPERVISED runs — the in-process
+        # alert engine (a supervised attempt's rules are evaluated by the
+        # supervisor, which sees every host's stream and survives a wedged
+        # collective; running them here too would double-fire every alert).
+        self.heartbeat = obs.HeartbeatEmitter(
+            self.bus, every_s=getattr(hparams, "heartbeat_secs", 10.0)
+        )
+        self.resources = obs.ResourceSampler(
+            ckpt_root=getattr(hparams, "ckpt_path", None)
+        )
+        self.alert_engine = None
+        specs = getattr(hparams, "alert", None)
+        if specs and os.environ.get(obs.RUN_ID_ENV) is None:
+            self.alert_engine = obs.AlertEngine(
+                obs.parse_alert_specs(specs),
+                bus=self.bus,
+                heartbeats=self.heartbeat,
+            )
+            self.bus.subscribe(self.alert_engine.observe_event)
+            # heartbeat-age rules evaluate from their own daemon thread:
+            # a tick that only runs on the trainer thread stops exactly
+            # when the hang it watches for begins
+            self.alert_engine.start_ticker()
+        self.exporter = obs.start_exporter(
+            getattr(hparams, "metrics_port", 0),
+            jax.process_index(),
+            registry=self.metrics,
+            heartbeats=self.heartbeat,
+            alerts=self.alert_engine,
+        )
+
+    def _obs_tick(self, *, epoch: int, step: int) -> None:
+        """The per-chunk-boundary observability work: one heartbeat (rate-
+        limited to ``--heartbeat-secs``), the resource gauges when a flush
+        is due (the sampler additionally rate-limits its own ~1 ms
+        ``/proc`` pass; stale gauges persist in the registry so every
+        flush still carries values), and the metric flush itself.  The
+        in-process alert engine needs nothing here: window rules ride the
+        bus tap and age rules tick on their own daemon thread (a tick on
+        THIS thread would double the window rate and stop exactly when
+        the hang it watches for begins).  Cost when nothing is due: two
+        clock reads and a lock."""
+        self.heartbeat.beat(
+            epoch=epoch, step=step, flush_seq=self.metrics.flushes
+        )
+        if self.metrics.flush_due():
+            self.resources.sample(self.metrics)
+            self.metrics.maybe_flush(self.bus, epoch=epoch, step=step)
 
     def _ckpt_meta(self) -> dict:
         """Manifest metadata every resumable save carries: the saving mesh
@@ -820,7 +871,13 @@ class Trainer:
             # drain the sketches at every epoch boundary regardless of the
             # step budget: per-attempt stats reconstruct exactly, and a
             # preempted next epoch can lose at most ITS OWN steps' samples
+            self.resources.sample(self.metrics)
             self.metrics.flush(self.bus, epoch=epoch)
+            self.heartbeat.beat(
+                epoch=epoch,
+                step=(epoch + 1) * self.steps_per_epoch,
+                flush_seq=self.metrics.flushes,
+            )
             for k, v in getattr(self, "_moe_health", {}).items():
                 # moe_dropped_frac → moe/dropped_frac, moe_load_max →
                 # moe/load_max: a collapsed router (load_max → 1.0) or
@@ -1487,9 +1544,7 @@ class Trainer:
             chunk_metrics.append(metrics)  # (take,) device arrays; no sync
             done += take
             self.metrics.note_steps(take)
-            self.metrics.maybe_flush(
-                self.bus, epoch=epoch, step=epoch * steps + done
-            )
+            self._obs_tick(epoch=epoch, step=epoch * steps + done)
             if bar is not None:
                 bar.update(take)
             if done < steps and self._preempt_due(
@@ -1633,9 +1688,7 @@ class Trainer:
                 chunk_metrics.append(metrics)  # (take,) device arrays; no sync
                 done = start + take
                 self.metrics.note_steps(take)
-                self.metrics.maybe_flush(
-                    self.bus, epoch=epoch, step=epoch * steps + done
-                )
+                self._obs_tick(epoch=epoch, step=epoch * steps + done)
                 if bar is not None:
                     bar.update(take)
                 if done < steps and self._preempt_due(
@@ -1753,6 +1806,11 @@ class Trainer:
         # events, then release the process-current bus/recorder (sequential
         # Trainers in one process must not cross-write)
         self.metrics.flush(self.bus)
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.alert_engine is not None:
+            self.alert_engine.close()
+            self.bus.unsubscribe(self.alert_engine.observe_event)
         if self._obs_enabled and self._obs_dir is not None:
             obs.write_chrome_trace(
                 self._obs_dir

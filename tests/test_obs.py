@@ -68,6 +68,8 @@ def _fresh_obs(monkeypatch):
 
 
 def test_emit_buffers_before_bind_and_flushes(tmp_path):
+    for kind in ("alpha", "beta", "gamma"):  # embedder kinds: registered
+        obs.register_kind(kind)
     bus = EventBus(run_id="r" * 16, attempt=2, process_index=0)
     bus.emit("alpha", epoch=0, note="early")
     bus.emit("beta", step=5)
@@ -114,6 +116,7 @@ def test_crash_dump_per_attempt_never_clobbers(tmp_path):
 
 
 def test_payload_coercion_numpy_and_paths(tmp_path):
+    obs.register_kind("mix")
     bus = EventBus()
     bus.bind_dir(tmp_path)
     bus.emit(
@@ -226,8 +229,13 @@ def test_default_bus_is_ring_only():
 
 
 def test_validate_event_accepts_the_canonical_shape():
-    ev = EventBus(run_id="f" * 16).emit("kind", epoch=1, step=2, x=1)
+    ev = EventBus(run_id="f" * 16).emit("run_start", epoch=1, step=2, x=1)
     assert obs.validate_event(ev) == []
+    # embedder kinds are admitted through the registry, not by accident
+    ev2 = EventBus(run_id="f" * 16).emit("my_embedder_kind")
+    assert obs.validate_event(ev2) != []
+    obs.register_kind("my_embedder_kind")
+    assert obs.validate_event(ev2) == []
 
 
 @pytest.mark.parametrize(
@@ -242,10 +250,15 @@ def test_validate_event_accepts_the_canonical_shape():
         (lambda e: e.update(attempt=-1), "field 'attempt' is negative"),
         (lambda e: e.update(run_id=""), "run_id is empty"),
         (lambda e: e.update(payload=[1]), "payload has type list"),
+        (
+            lambda e: e.update(kind="unregistered_drift"),
+            "kind 'unregistered_drift' is not registered "
+            "(obs.bus.KNOWN_KINDS / register_kind)",
+        ),
     ],
 )
 def test_validate_event_catches_violations(mutate, expect):
-    ev = EventBus(run_id="f" * 16).emit("kind", epoch=1, x=1)
+    ev = EventBus(run_id="f" * 16).emit("run_start", epoch=1, x=1)
     mutate(ev)
     assert expect in obs.validate_event(ev)
 

@@ -255,6 +255,8 @@ def test_mmap_ring_torn_page_decodes_surviving_prefix(tmp_path):
 
 
 def test_bus_attach_ring_seeds_prebind_events(tmp_path):
+    for kind in ("early", "late"):  # ad-hoc test kinds: registered
+        obs.register_kind(kind)
     bus = EventBus(run_id="ab" * 8, persist=False)
     bus.emit("early", note=1)
     assert bus.attach_ring(tmp_path / "flight.ring") is not None

@@ -35,9 +35,10 @@ def load_report(path: str | Path) -> dict:
         records = load_goodput_records(path)
         if any("kind" in r for r in records):
             # a unified events.jsonl stream (obs bus): the goodput records
-            # ride `goodput`-kind events' payloads; every other kind —
-            # including the periodic `metrics` flushes — is not an attempt
-            # record and must not count as one
+            # ride `goodput`-kind events' payloads; every OTHER kind —
+            # today's `metrics`/`heartbeat`/`alert`/…, and whatever kinds
+            # future PRs add — is not an attempt record and must not count
+            # as one (forward-compat contract pinned by tests/test_fleet.py)
             records = [
                 r.get("payload") or {}
                 for r in records

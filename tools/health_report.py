@@ -27,6 +27,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 TAIL_EVENTS = 8
 
+# The kinds the echo shows.  Everything else — today's `metrics` flushes
+# and `heartbeat` liveness ticks, and whatever kinds future PRs add — is
+# condensed to a per-kind count instead of burying the health verdicts
+# (this tool needed a patch when `metrics` appeared; unknown kinds must
+# never break it again).  Legacy health.jsonl records carry no `kind`
+# envelope field at all and always echo.
+ECHO_KINDS = {"skip", "spike", "rollback", "desync", "abort", "preempt"}
+
 
 def summarize_events(events: list[dict]) -> dict:
     """Fold raw health.jsonl events into the HEALTH.json counter shape.
@@ -102,12 +110,24 @@ def format_table(reports: list[tuple[str, dict]]) -> str:
         run_ids = {e["run_id"] for e in events if e.get("run_id")}
         if run_ids:
             tail.append(f"  [{name}] run {'+'.join(sorted(run_ids))}")
-        # a unified stream's periodic `metrics` flushes are sketches, not
-        # health verdicts — they would bury the echo; condense them
-        echoable = [e for e in events if e.get("kind") != "metrics"]
-        n_metrics = len(events) - len(echoable)
-        if n_metrics:
-            tail.append(f"  [{name}] ({n_metrics} metrics flush(es) elided)")
+        # a unified stream carries far more than health verdicts (metrics
+        # flushes, heartbeats, whatever kinds future PRs add) — condense
+        # everything outside the echo set to per-kind counts instead of
+        # burying the verdicts (or crashing on a kind this tool predates)
+        echoable = [
+            e for e in events
+            if "kind" not in e or e.get("kind") in ECHO_KINDS
+        ]
+        elided: dict[str, int] = {}
+        for e in events:
+            k = e.get("kind")
+            if k is not None and k not in ECHO_KINDS:
+                elided[k] = elided.get(k, 0) + 1
+        if elided:
+            counts = ", ".join(
+                f"{k}×{n}" for k, n in sorted(elided.items())
+            )
+            tail.append(f"  [{name}] (elided non-health events: {counts})")
         for ev in echoable[-TAIL_EVENTS:]:
             prefix = f"a{ev['attempt']} " if "attempt" in ev else ""
             bare = {k: v for k, v in ev.items() if k not in stamp_keys}

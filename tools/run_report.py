@@ -8,6 +8,10 @@ Usage::
     python tools/run_report.py version-0/events.jsonl --timeline 50
     python tools/run_report.py CKPT_ROOT --follow     # tail an in-flight run
     python tools/run_report.py CKPT_ROOT --blackbox   # decode flight rings
+    python tools/run_report.py CKPT_ROOT --alerts     # alert timeline; rc=1
+                                                      # while any rule fires
+    python tools/run_report.py CKPT_ROOT --export-openmetrics [OUT]
+                                                      # offline scrape render
     python tools/run_report.py CKPT_ROOT --xplane OUT.json \\
         --profile-dir PROFILE_DIR                     # host+device Perfetto
 
@@ -22,8 +26,10 @@ single jsonl file also works.
 
 Cross-host merge no longer trusts NTP: per-host clock offsets are fitted
 from the ``run_start`` events every process emits together (post-broadcast,
-so near-simultaneous on the true timeline) and subtracted before ordering.
-One-host runs and runs without shared anchors merge unshifted.
+so near-simultaneous on the true timeline) and subtracted before ordering —
+one offset per host *per attempt*, so clock drift across a multi-day run's
+restarts is refitted at every relaunch.  One-host runs and runs without
+shared anchors merge unshifted.
 
 ``--check`` validates every record against the versioned event schema
 (``obs/bus.py``) and exits nonzero on any violation — bench legs run it so
@@ -61,12 +67,16 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from distributed_training_comparison_tpu.obs import (  # noqa: E402
+    alert_timeline,
     collect_black_box,
     decode_ring,
+    final_states,
     find_rings,
     histogram_summary,
     load_events,
     merge_metric_events,
+    render_openmetrics,
+    straggler,
     validate_event,
 )
 
@@ -76,6 +86,9 @@ TIMELINE_TAIL = 20
 SUPERVISOR_KINDS = {
     "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
 }
+# live-operations kinds: summarized fleet-wide, not per attempt (stall/
+# straggler/alert payloads name the attempt+process they concern)
+FLEET_KINDS = {"stall", "straggler", "alert"}
 
 
 def find_event_files(path: str | Path) -> list[Path]:
@@ -88,17 +101,17 @@ def find_event_files(path: str | Path) -> list[Path]:
 
 
 def load_run(
-    path: str | Path, skew_out: dict[int, float] | None = None
+    path: str | Path, skew_out: dict | None = None
 ) -> tuple[list[dict], list[Path]]:
     """All events under ``path``, merged and wall-clock ordered (per-host
     clock skew estimated and removed before ordering).  ``skew_out``, if
-    given, receives the fitted per-process offsets — callers that report
-    them don't re-read the files."""
+    given, receives the fitted per-(process, attempt) offsets — callers
+    that report them don't re-read the files."""
     files = find_event_files(path)
     events: list[dict] = []
     for f in files:
         events.extend(load_events(f))
-    offsets = estimate_clock_skew(events)
+    offsets = estimate_clock_skew_by_attempt(events)
     if skew_out is not None:
         skew_out.update(offsets)
     events = apply_clock_skew(events, offsets)
@@ -156,16 +169,62 @@ def estimate_clock_skew(events: list[dict]) -> dict[int, float]:
     return offsets
 
 
-def apply_clock_skew(
-    events: list[dict], offsets: dict[int, float]
-) -> list[dict]:
-    """Shift each event's ``t_wall`` onto process 0's clock (events from
-    processes with a zero/absent offset pass through untouched)."""
-    if not any(abs(v) > 1e-9 for v in offsets.values()):
+def estimate_clock_skew_by_attempt(events: list[dict]) -> dict:
+    """Per-(process, attempt) wall-clock offsets — the multi-day-drift
+    refinement of ``estimate_clock_skew``: one constant per host was fine
+    for one attempt, but a run whose attempts span days accumulates real
+    drift between them, and each attempt's ``run_start`` anchors already
+    measure their own instant.  Returns ``{(process, attempt): offset}``
+    plus a ``(process, None)`` fallback (the across-attempt median) for
+    events of an attempt that died before its anchor."""
+    anchors: dict[tuple, dict[int, float]] = defaultdict(dict)
+    for ev in events:
+        kind = ev.get("kind")
+        if kind not in _SYNC_KINDS or ev.get("t_wall") is None:
+            continue
+        key = (ev.get("attempt", 0), kind)
+        anchors[key].setdefault(int(ev.get("process_index", 0)), ev["t_wall"])
+    offsets: dict = {}
+    per_proc: dict[int, list[float]] = defaultdict(list)
+    for (attempt, _kind), procs in anchors.items():
+        if 0 not in procs:
+            continue
+        for p, t in procs.items():
+            if p == 0:
+                continue
+            delta = t - procs[0]
+            # multiple anchor kinds per attempt would land here twice;
+            # the first fitted one wins (today there is one: run_start)
+            offsets.setdefault((p, attempt), delta)
+            per_proc[p].append(delta)
+    processes = {int(e.get("process_index", 0)) for e in events}
+    for p in processes:
+        ds = sorted(per_proc.get(p, []))
+        mid = len(ds) // 2
+        offsets[(p, None)] = (
+            0.0 if not ds
+            else ds[mid] if len(ds) % 2 else 0.5 * (ds[mid - 1] + ds[mid])
+        )
+    return offsets
+
+
+def apply_clock_skew(events: list[dict], offsets: dict) -> list[dict]:
+    """Shift each event's ``t_wall`` onto process 0's clock.  Accepts the
+    per-process shape (``{process: offset}``) and the per-attempt shape
+    (``{(process, attempt): offset}`` with ``(process, None)`` fallbacks);
+    events with a zero/absent offset pass through untouched."""
+    if not offsets or not any(abs(v) > 1e-9 for v in offsets.values()):
         return events
+    by_attempt = any(isinstance(k, tuple) for k in offsets)
     out = []
     for ev in events:
-        off = offsets.get(int(ev.get("process_index", 0)), 0.0)
+        p = int(ev.get("process_index", 0))
+        if by_attempt:
+            off = offsets.get((p, int(ev.get("attempt", 0))))
+            if off is None:
+                off = offsets.get((p, None), 0.0)
+        else:
+            off = offsets.get(p, 0.0)
         if abs(off) > 1e-9 and ev.get("t_wall") is not None:
             ev = dict(ev, t_wall=ev["t_wall"] - off)
         out.append(ev)
@@ -217,11 +276,12 @@ def summarize(events: list[dict]) -> dict:
             "skips": 0, "spikes": 0, "desyncs": 0, "aborts": [],
             "preempt": None, "goodput": None, "writer": None,
             "t_first": None, "t_last": None, "processes": set(),
-            "metrics_events": 0, "metrics": {},
+            "metrics_events": 0, "metrics": {}, "heartbeats": 0,
         }
     )
     run_ids: set[str] = set()
     supervisor: list[dict] = []
+    fleet: list[dict] = []
     for ev in events:
         if ev.get("run_id"):
             run_ids.add(ev["run_id"])
@@ -229,12 +289,20 @@ def summarize(events: list[dict]) -> dict:
         if kind in SUPERVISOR_KINDS:
             supervisor.append(ev)
             continue
+        if kind in FLEET_KINDS:
+            fleet.append(ev)
+            continue
         a = attempts[int(ev.get("attempt", 0))]
         t = ev.get("t_wall")
         if t is not None:
             a["t_first"] = t if a["t_first"] is None else min(a["t_first"], t)
             a["t_last"] = t if a["t_last"] is None else max(a["t_last"], t)
         a["processes"].add(int(ev.get("process_index", 0)))
+        if kind == "heartbeat":
+            # liveness ticks from EVERY process count (that is their job);
+            # they carry no per-attempt work to fold beyond the count
+            a["heartbeats"] += 1
+            continue
         if int(ev.get("process_index", 0)) != 0:
             # every process emits the same trainer/watchdog events into its
             # own file; count each occurrence once (process 0's) so a
@@ -291,6 +359,12 @@ def summarize(events: list[dict]) -> dict:
         "run_ids": sorted(run_ids),
         "attempts": {k: attempts[k] for k in sorted(attempts)},
         "supervisor": supervisor,
+        "fleet": fleet,
+        # the per-host step-phase table + findings the straggler module
+        # computes straight off the (per-process) metrics events — the
+        # cross-host view the per-attempt fold above deliberately dedups
+        # away
+        "straggler_lines": straggler.format_table(events),
         "events": len(events),
         "rollbacks": sum(a["rollbacks"] for a in attempts.values()),
         "epochs": sum(a["epochs"] for a in attempts.values()),
@@ -399,6 +473,56 @@ def format_summary(name: str, s: dict) -> str:
                 lines.append(f"    {nm}: {snap.get('n', 0)}")
             else:
                 lines.append(f"    {nm}: {snap.get('value')}")
+    beats = sum(a.get("heartbeats", 0) for a in s["attempts"].values())
+    if beats:
+        lines.append(
+            "  heartbeats: "
+            + ", ".join(
+                f"attempt {idx}: {a['heartbeats']}"
+                for idx, a in s["attempts"].items()
+                if a.get("heartbeats")
+            )
+        )
+    lines.extend(s.get("straggler_lines") or [])
+    # stall calls condense to one line per process (counts per state +
+    # the final state) — a run whose heartbeat cadence undershoots its
+    # chunk time can transition hundreds of times, and the echo must not
+    # bury the table; the full sequence lives in `--alerts`
+    stall_by_proc: dict = {}
+    for ev in s.get("fleet") or []:
+        p = _payload(ev)
+        if ev["kind"] == "stall":
+            rec = stall_by_proc.setdefault(
+                p.get("process_index", "?"), {"counts": {}, "last": None}
+            )
+            state = p.get("state", "?")
+            rec["counts"][state] = rec["counts"].get(state, 0) + 1
+            rec["last"] = p
+        elif ev["kind"] == "alert":
+            lines.append(
+                f"  alert {p.get('state', '?')}: {p.get('spec', '?')} "
+                f"(value {p.get('value', '?')}"
+                + (
+                    f" @ {p['source']}" if p.get("source") else ""
+                )
+                + ")"
+            )
+        # straggler events echo what straggler_lines already tabulates
+    for proc, rec in sorted(stall_by_proc.items(), key=lambda kv: str(kv[0])):
+        counts = ", ".join(
+            f"{state}×{n}" for state, n in sorted(rec["counts"].items())
+        )
+        last = rec["last"] or {}
+        lines.append(
+            f"  stalls: process {proc} {counts} "
+            f"(last: {last.get('state', '?')}, age {last.get('age_s', '?')}s"
+            + (
+                f", {last['behind_steps']} steps behind"
+                if last.get("behind_steps") is not None
+                else ""
+            )
+            + ")"
+        )
     if s["supervisor"]:
         sup = ", ".join(
             f"{e['kind']}[a{_sup_attempt(e)}]" for e in s["supervisor"]
@@ -473,37 +597,18 @@ def follow_events(
     restart attempt opens its own ``events*.jsonl``), remembers a byte
     offset per file, and never yields a torn trailing line (it stays
     buffered until the writer completes it).  ``max_polls`` bounds the
-    loop for tests/scripting; None polls until interrupted."""
-    offsets: dict[Path, int] = {}
+    loop for tests/scripting; None polls until interrupted.
+
+    One loop over ``obs.EventTailer`` — the same incremental reader the
+    supervisor's fleet watcher polls, so the two tails can never drift.
+    """
+    from distributed_training_comparison_tpu.obs import EventTailer
+
+    tailer = EventTailer(path)
     polls = 0
     while True:
-        batch: list[dict] = []
-        for f in find_event_files(path):
-            pos = offsets.get(f, 0)
-            try:
-                with open(f, "rb") as fh:
-                    fh.seek(pos)
-                    chunk = fh.read()
-            except OSError:
-                continue
-            if not chunk:
-                continue
-            # only complete lines are consumed; a partial tail stays for
-            # the next poll
-            keep = chunk.rfind(b"\n") + 1
-            if keep == 0:
-                continue
-            offsets[f] = pos + keep
-            for line in chunk[:keep].splitlines():
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    batch.append(json.loads(line))
-                except ValueError:
-                    continue
+        batch = tailer.poll()
         if batch:
-            batch.sort(key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)))
             yield batch
         polls += 1
         if max_polls is not None and polls >= max_polls:
@@ -576,12 +681,23 @@ def xplane_merge(
             host_traces.append(json.loads(f.read_text()))
         except (OSError, ValueError) as e:
             log(f"skipping unreadable host trace {f}: {e}")
-    profiler_events = load_profiler_chrome_events(profile_dir)
+    profiler_events = load_profiler_chrome_events(
+        profile_dir, warn=lambda msg: log(f"warning: {msg}")
+    )
     if not host_traces and not profiler_events:
         log(f"nothing to merge: no trace*.json under {path} and no "
             f"xplane/trace artifacts under {profile_dir}")
         return 2
     doc, info = merge_host_and_xplane(host_traces, profiler_events)
+    if info["aligned"] == "first_event" and host_traces and profiler_events:
+        # degraded but usable: both sides render as lanes, just not
+        # step-aligned — say so instead of letting the offset pass as real
+        log(
+            "warning: no shared StepTraceAnnotation step ids between the "
+            "host spans and the device capture (an older capture, renamed "
+            "annotations, or a run without --profile-dir step marks) — "
+            "lanes are aligned on first-event time, not on steps"
+        )
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
     with open(out_path, "w") as f:
@@ -593,6 +709,94 @@ def xplane_merge(
         f"step id(s), offset {info['offset_us'] / 1e3:.3f} ms)"
     )
     return 0
+
+
+# ------------------------------------------------------------------ alerts
+
+
+def alerts_report(path: str | Path, out=print) -> int:
+    """The ``--alerts`` view: every ``alert`` event under ``path`` as a
+    firing/resolved timeline, plus the stall calls for context.  Exit 0
+    when no rule is left firing — including when no alert/stall event
+    exists at all (a run without ``--alert`` rules is not unhealthy; the
+    printed note distinguishes it) — 1 while any rule still fires (the
+    CI gate: a run whose alerts never resolved is not a run to trust),
+    2 when ``path`` holds no events whatsoever."""
+    events, _files = load_run(path)
+    if not events:
+        out(f"{path}: no events found")
+        return 2
+    timeline = alert_timeline(events)
+    stalls = [e for e in events if e.get("kind") == "stall"]
+    if not timeline and not stalls:
+        out(f"{path}: no alert or stall events (no --alert rules, or "
+            "none ever transitioned)")
+        return 0
+    t0 = events[0].get("t_wall", 0.0)
+    for ev in sorted(
+        timeline + stalls,
+        key=lambda e: (e.get("t_wall", 0.0), e.get("t_mono", 0.0)),
+    ):
+        p = ev.get("payload") or {}
+        if ev.get("kind") == "stall":
+            out(
+                f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s] stall: "
+                f"process {p.get('process_index', '?')} {p.get('state', '?')} "
+                f"(age {p.get('age_s', '?')}s)"
+            )
+        else:
+            out(
+                f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s] "
+                f"{p.get('state', '?').upper():>8}: {p.get('spec', '?')} "
+                f"value={p.get('value', '?')} threshold={p.get('threshold', '?')}"
+                + (f" source={p['source']}" if p.get("source") else "")
+            )
+    firing = [
+        spec for (spec, _src), state in final_states(events).items()
+        if state == "firing"
+    ]
+    if firing:
+        out(f"STILL FIRING: {', '.join(sorted(set(firing)))}")
+        return 1
+    out("all alerts resolved")
+    return 0
+
+
+def export_openmetrics(path: str | Path, out_path: str | None = None) -> str:
+    """The scrape-less exposition: fold a finished (or in-flight) run's
+    ``metrics`` events — plus the serve records' latency deltas — into
+    one cumulative registry view and render the same OpenMetrics text the
+    live ``--metrics-port`` endpoint serves.  Heartbeat ages are relative
+    to the newest event in the stream; alert states are each rule's last
+    transition."""
+    events, _files = load_run(path)
+    payloads = []
+    for ev in events:
+        if ev.get("kind") == "metrics":
+            payloads.append(ev)
+        elif ev.get("kind") == "serve" and (ev.get("payload") or {}).get(
+            "latency_hist"
+        ):
+            payloads.append(
+                {"metrics": {"serve/latency_s": ev["payload"]["latency_hist"]}}
+            )
+    metrics = merge_metric_events(payloads)
+    t_end = max((e.get("t_wall", 0.0) for e in events), default=0.0)
+    ages: dict[str, float] = {}
+    for ev in events:
+        if ev.get("kind") == "heartbeat" and ev.get("t_wall") is not None:
+            key = f"p{int(ev.get('process_index', 0))}"
+            age = max(0.0, t_end - ev["t_wall"])
+            ages[key] = min(age, ages.get(key, age))
+    # firing if ANY source's final state fires — a dict keyed by spec
+    # alone would let one process's resolve mask another's live breach
+    states: dict[str, bool] = {}
+    for (spec, _src), state in final_states(events).items():
+        states[spec] = states.get(spec, False) or state == "firing"
+    text = render_openmetrics(metrics, ages or None, states or None)
+    if out_path and out_path != "-":
+        Path(out_path).write_text(text)
+    return text
 
 
 # -------------------------------------------------------------------- diff
@@ -660,6 +864,19 @@ def main(argv: list[str]) -> int:
         "(the SIGKILL-surviving recorder's pull)",
     )
     ap.add_argument(
+        "--alerts", action="store_true",
+        help="print the alert firing/resolved timeline (+ stall calls); "
+        "exit 1 while any rule is still firing — the CI gate",
+    )
+    ap.add_argument(
+        "--export-openmetrics", metavar="OUT", default=None, nargs="?",
+        const="-",
+        help="render the run's merged metrics/heartbeats/alerts in the "
+        "OpenMetrics text format (same exposition as the live "
+        "--metrics-port endpoint); OUT is a file path or '-'/omitted "
+        "for stdout",
+    )
+    ap.add_argument(
         "--xplane", metavar="OUT.json", default=None,
         help="write ONE Perfetto file merging the run's host span traces "
         "with the --profile-dir device capture, joined on step ids",
@@ -681,6 +898,23 @@ def main(argv: list[str]) -> int:
         for path in args.paths:
             rc = max(rc, blackbox_report(path))
         return rc
+
+    if args.alerts:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, alerts_report(path))
+        return rc
+
+    if args.export_openmetrics is not None:
+        if len(args.paths) != 1:
+            # one exposition renders one run; silently rendering only the
+            # first of several roots would pass half a fleet off as whole
+            print("--export-openmetrics takes exactly one path", file=sys.stderr)
+            return 2
+        text = export_openmetrics(args.paths[0], args.export_openmetrics)
+        if args.export_openmetrics == "-":
+            sys.stdout.write(text)
+        return 0
 
     if args.follow:
         t0: float | None = None
@@ -727,19 +961,24 @@ def main(argv: list[str]) -> int:
 
     rc = 0
     for path in args.paths:
-        offsets: dict[int, float] = {}
+        offsets: dict = {}
         events, files = load_run(path, skew_out=offsets)
         if not events:
             print(f"{path}: no events found", file=sys.stderr)
             rc = 2
             continue
         print(format_summary(str(path), summarize(events)))
-        skew = {p: off for p, off in offsets.items() if abs(off) > 1e-3}
+        skew = {
+            key: off
+            for key, off in offsets.items()
+            if key[1] is not None and abs(off) > 1e-3
+        }
         if skew:
             print(
                 "  clock skew removed before merge: "
                 + ", ".join(
-                    f"p{p} {off:+.3f}s" for p, off in sorted(skew.items())
+                    f"p{p}@a{att} {off:+.3f}s"
+                    for (p, att), off in sorted(skew.items())
                 )
             )
         print()
