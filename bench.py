@@ -130,24 +130,18 @@ def train_flops_per_image(
     return 3.0 * forward_flops_per_image(name, image_size=image_size, stem=stem)
 
 
-# per-chip peak dense-matmul FLOP/s (bf16), by jax device_kind
-_PEAK_FLOPS = {
-    "TPU v3": 123e12 / 2,  # per chip = 2 cores × ~61.5 TF... jax exposes cores
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
+# per-chip peak dense-matmul FLOP/s (bf16), by jax device_kind — ONE table,
+# owned by obs/compilation.py (run_report --compute keys its measured-MFU
+# denominator off the same numbers, so bench MFU and event-stream MFU can
+# never disagree about what "peak" means)
+from distributed_training_comparison_tpu.obs.compilation import (  # noqa: E402
+    PEAK_FLOPS_BY_DEVICE_KIND as _PEAK_FLOPS,
+    peak_flops_for as _peak_flops_for,
+)
 
 
 def chip_peak_flops() -> float | None:
-    kind = jax.devices()[0].device_kind
-    for k, v in _PEAK_FLOPS.items():
-        if kind.startswith(k):
-            return v
-    return None
+    return _peak_flops_for(jax.devices()[0].device_kind)
 
 
 # ----------------------------------------------------------------- harness
@@ -623,11 +617,27 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
         open_rates, open_requests = (1000.0, 4000.0, 16000.0), 4096
         max_wait_ms, queue_limit = 2.0, 4096
 
+    # the capture's own event stream: bucket compiles land as `compile`
+    # events (cost/memory analysis + cache outcome) and the per-bucket
+    # dispatch sketches flush at the end — the committed record then
+    # self-validates with run_report --check --require-kind compile, so a
+    # silently-degraded compile hook can't produce a trusted capture
+    import tempfile
+
+    from distributed_training_comparison_tpu import obs
+
+    serve_events_root = tempfile.mkdtemp(prefix="serve-bench-")
+    bus = obs.configure(run_id=obs.new_run_id())
+    bus.bind_dir(serve_events_root)
+    registry = obs.MetricRegistry()
+    monitor = obs.CompileMonitor(bus=bus, registry=registry)
+
     engine = ServeEngine(
         model_name=model_name,
         buckets=buckets,
         precision="bf16",
         image_size=image_size,
+        monitor=monitor,
     )
     t0 = time.perf_counter()
     engine.warmup()
@@ -674,6 +684,8 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
             ),
         )
 
+    registry.flush(bus)  # per-bucket exec/... dispatch sketches → stream
+    obs.reset(bus)
     record = {
         "metric": "cifar100_resnet18_serve",
         "platform": platform,
@@ -684,6 +696,10 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
         "max_wait_ms": max_wait_ms,
         "queue_limit": queue_limit,
         "warmup_compile_s": round(warmup_s, 2),
+        "compile_ledger": monitor.ledger(),
+        "events_check_rc": events_check_rc(
+            serve_events_root, require_kinds=("compile",)
+        ),
         "legs": legs,
     }
     with open(out_path, "w") as f:
@@ -691,6 +707,7 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
     print(json.dumps({
         "metric": record["metric"],
         "platform": platform,
+        "events_check_rc": record["events_check_rc"],
         "legs": {
             k: (
                 {
@@ -709,20 +726,25 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
     return record
 
 
-def events_check_rc(ckpt_root: str) -> int:
+def events_check_rc(ckpt_root: str, require_kinds=()) -> int:
     """Self-validate a bench capture: ``tools/run_report.py --check`` over
     every ``events*.jsonl`` the run left behind, returncode recorded in the
     committed JSON (0 = every record parses against the versioned obs
-    schema) — nobody trusts the numbers of a capture that doesn't."""
+    schema) — nobody trusts the numbers of a capture that doesn't.
+    ``require_kinds`` additionally fails the check unless the stream
+    carries those kinds: the resilience/serve legs require ``compile``
+    events, so a silently-degraded compile hook can't commit a capture
+    whose ledger is missing."""
     import os
     import subprocess
     import sys
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    return subprocess.run(
-        [sys.executable, os.path.join(repo, "tools", "run_report.py"),
-         ckpt_root, "--check"],
-    ).returncode
+    cmd = [sys.executable, os.path.join(repo, "tools", "run_report.py"),
+           ckpt_root, "--check"]
+    for kind in require_kinds or ():
+        cmd += ["--require-kind", kind]
+    return subprocess.run(cmd).returncode
 
 
 def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
@@ -812,7 +834,11 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     )
     record["supervisor"] = summary
     record["platform"] = platform
-    record["events_check_rc"] = events_check_rc(ckpt_root)
+    # compile events required: every attempt's trainer must have emitted
+    # its per-executable ledger (PR 8) or the capture fails itself
+    record["events_check_rc"] = events_check_rc(
+        ckpt_root, require_kinds=("compile",)
+    )
     write_goodput(out_path, record)
     print(json.dumps({
         "metric": record["metric"],
@@ -1032,6 +1058,7 @@ def bench_obs_overhead(
     with_t, flushes = run_loop(True)
     without_t, _ = run_loop(False)
     overhead_us = (with_t - without_t) / steps * 1e6
+    compile_leg = _bench_obs_compile_leg(ckpt_root, budget_us_per_step)
     real = _bench_obs_real_step(Path(ckpt_root))
     record = {
         "metric": "obs_overhead",
@@ -1043,8 +1070,13 @@ def bench_obs_overhead(
         "overhead_us_per_step": round(overhead_us, 3),
         "budget_us_per_step": budget_us_per_step,
         "within_budget": bool(overhead_us < budget_us_per_step),
+        "compile_capture": compile_leg,
         "real_step": real,
-        "events_check_rc": events_check_rc(ckpt_root),
+        # the compile leg's observed compile must be ON the stream — a
+        # capture without it means the hook silently degraded
+        "events_check_rc": events_check_rc(
+            ckpt_root, require_kinds=("compile",)
+        ),
         "platform": jax.devices()[0].platform,
     }
     with open(out_path, "w") as f:
@@ -1054,11 +1086,78 @@ def bench_obs_overhead(
         "metric", "steps", "flushes", "overhead_us_per_step",
         "budget_us_per_step", "within_budget", "events_check_rc", "platform",
     )} | {
+        "compile_capture_us_per_step": compile_leg.get("overhead_us_per_step"),
+        "compile_capture_within_budget": compile_leg.get("within_budget"),
         "real_step_overhead_us": real.get("overhead_us_per_step"),
         "scrape_ok": real.get("scrape_ok"),
         "full_record": out_path,
     }))
     return record
+
+
+def _bench_obs_compile_leg(
+    ckpt_root, budget_us_per_step: float, dispatches: int = 2000,
+    chunk: int = 32, leaves: int = 128,
+) -> dict:
+    """Price the compile-capture hook's DISPATCH side: what every chunk
+    dispatch pays for riding the instrumented path instead of calling the
+    jitted function directly (obs/compilation.py).
+
+    The compile itself happens once per executable and is not a per-step
+    cost; the recurring price is the wrapper's signature key (one pytree
+    flatten + a (shape, dtype) tuple over a ``leaves``-leaf state — the
+    realistic shape of a train-state arg) plus the per-executable
+    dispatch-histogram record.  Two identical loops dispatch the same
+    tiny tree-map program ``dispatches`` times, instrumented vs plain
+    jit; the delta per dispatch, divided by the chunk length a dispatch
+    amortizes over, is the per-trained-step price judged against the
+    same 25 µs budget as the record path.  The observed compile lands on
+    the bound bus, so the capture's event stream carries a ``compile``
+    event for the self-check to require."""
+    import jax.numpy as jnp
+
+    from distributed_training_comparison_tpu import obs
+
+    tree = {f"w{i}": jnp.zeros((4, 4), jnp.float32) for i in range(leaves)}
+    fn = jax.jit(
+        lambda t: jax.tree_util.tree_map(lambda x: x + 1.0, t)
+    )
+
+    obs.reset()
+    bus = obs.configure(run_id=obs.new_run_id())
+    bus.bind_dir(ckpt_root)
+    registry = obs.MetricRegistry(flush_steps=10 ** 9)
+    monitor = obs.CompileMonitor(bus=bus, registry=registry)
+    inst = monitor.instrument(fn, "bench_state_update")
+
+    def loop(call) -> float:
+        t = call(tree)  # warm: compile (observed once on the inst path)
+        t0 = time.perf_counter()
+        for _ in range(dispatches):
+            t = call(t)
+        jax.block_until_ready(t)
+        return time.perf_counter() - t0
+
+    without_t = loop(fn)
+    with_t = loop(inst)
+    registry.flush(bus)
+    ledger = monitor.ledger()
+    obs.reset(bus)
+    per_dispatch_us = (with_t - without_t) / dispatches * 1e6
+    per_step_us = per_dispatch_us / chunk
+    return {
+        "dispatches": dispatches,
+        "state_leaves": leaves,
+        "chunk": chunk,
+        "with_monitor_s": round(with_t, 4),
+        "without_monitor_s": round(without_t, 4),
+        "overhead_us_per_dispatch": round(per_dispatch_us, 3),
+        "overhead_us_per_step": round(per_step_us, 3),
+        "budget_us_per_step": budget_us_per_step,
+        "within_budget": bool(per_step_us < budget_us_per_step),
+        "observed_compiles": sum(r["compiles"] for r in ledger),
+        "compile_s": round(sum(r["compile_s"] for r in ledger), 4),
+    }
 
 
 def _bench_obs_real_step(ckpt_root) -> dict:

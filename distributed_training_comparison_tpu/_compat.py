@@ -14,6 +14,11 @@ images carry.  Exactly three things have moved:
 - the Pallas TPU compiler-params dataclass: ``pltpu.CompilerParams``
   today, ``pltpu.TPUCompilerParams`` in older releases (same fields).
 
+Beyond those renames, this module also guards the *observability-only*
+API surface (device/executable memory stats, cost analysis, the
+monitoring listener, ``jax.live_arrays``): telemetry reads that degrade
+to "no data" instead of breaking training when a jax release moves them.
+
 Import them from here; everything else in the codebase uses stable API.
 """
 
@@ -105,6 +110,96 @@ def donated_cache_write_barred():
     return _min_compile_secs(1e18)
 
 
+# ---------------------------------------------------------------- compiler
+#
+# The compile-observability hook (obs/compilation.py) leans on four jax
+# surfaces that have each moved (or may move) between releases: the AOT
+# executable's cost/memory analyses, the internal monitoring listener the
+# persistent compile cache reports hits through, and jax.live_arrays.
+# Every accessor below degrades to None/False — compile telemetry must
+# never be the reason a run fails to import or train.
+
+
+def executable_cost_analysis(compiled) -> dict | None:
+    """``Compiled.cost_analysis()`` normalized to ONE flat dict (newer jax
+    returns the dict directly, older returns a one-element list of dicts);
+    ``None`` when the API is absent, raises, or reports nothing."""
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        out = fn()
+    except Exception:
+        return None
+    if isinstance(out, (list, tuple)):
+        out = out[0] if out else None
+    return out if isinstance(out, dict) and out else None
+
+
+def executable_memory_analysis(compiled) -> dict | None:
+    """``Compiled.memory_analysis()`` flattened to the byte counts the HBM
+    ledger wants (``{argument,output,temp,generated_code,alias}_bytes``);
+    ``None`` when absent/raising — the CPU CI backend HAS these today, but
+    the hook must outlive a jax that drops them."""
+    fn = getattr(compiled, "memory_analysis", None)
+    if fn is None:
+        return None
+    try:
+        stats = fn()
+    except Exception:
+        return None
+    if stats is None:
+        return None
+    out = {}
+    for key, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+    ):
+        v = getattr(stats, attr, None)
+        if isinstance(v, int):
+            out[key] = v
+    return out or None
+
+
+def register_monitoring_listener(callback) -> bool:
+    """Attach ``callback(event, **metadata)`` to jax's internal monitoring
+    stream (the persistent compile cache announces hits there as
+    ``/jax/compilation_cache/cache_hits``).  Private API — returns False
+    (and the caller reports cache state 'unknown') when it has moved."""
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(callback)
+        return True
+    except Exception:
+        return False
+
+
+def compilation_cache_dir() -> str | None:
+    """The configured persistent compile-cache directory, or None when
+    caching is off (then a compile can be neither a hit nor a miss)."""
+    try:
+        return _jax.config.jax_compilation_cache_dir or None
+    except Exception:
+        return None
+
+
+def live_arrays() -> list | None:
+    """``jax.live_arrays()`` or None where absent — the HBM census input
+    (obs/resource.py).  Callers must still guard per-array attribute
+    reads: a donated array in the list may already be deleted."""
+    fn = getattr(_jax, "live_arrays", None)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
 def device_memory_stats(device) -> dict | None:
     """``device.memory_stats()`` normalized across backends: a dict with
     at least ``bytes_in_use`` on allocator-backed devices (TPU/GPU), and
@@ -126,5 +221,7 @@ def device_memory_stats(device) -> dict | None:
 
 __all__ = [
     "shard_map", "axis_size", "CompilerParams", "donated_cache_write_barred",
-    "device_memory_stats",
+    "device_memory_stats", "executable_cost_analysis",
+    "executable_memory_analysis", "register_monitoring_listener",
+    "compilation_cache_dir", "live_arrays",
 ]

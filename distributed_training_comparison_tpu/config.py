@@ -613,9 +613,10 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "event (position + metric-flush sequence) at most once per S "
         "seconds, checked at the chunk boundaries the trainer already "
         "touches.  The supervisor's fleet watcher classifies a host whose "
-        "heartbeats go stale as slow (3 missed beats) vs dead (10) and "
-        "emits a 'stall' event before the collective wedges.  0 disables "
-        "heartbeats (and therefore stall detection)",
+        "heartbeats go stale as slow (3 missed beats) vs dead (10) — and "
+        "a host beating on schedule whose STEP stops advancing as stuck "
+        "(livelock) — and emits a 'stall' event before the collective "
+        "wedges.  0 disables heartbeats (and therefore stall detection)",
     )
     parser.add_argument(
         "--metrics-port",
@@ -636,12 +637,17 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         metavar="SPEC",
         help="Declarative alert rule, repeatable: METRIC:AGG{><}THRESHOLD"
         "[:for=N], e.g. 'serve/latency_s:p99>0.25:for=3' (p99 above 250ms "
-        "for 3 consecutive flush windows) or 'heartbeat:age>30' (any "
-        "process silent 30s).  AGG: p50/p95/p99/mean/max/min/count (histo"
-        "grams), value (gauges), n (counters), age (heartbeat).  for=N is "
-        "the hysteresis: N consecutive breaching windows to fire, N clean "
-        "ones to resolve.  Evaluated by the supervisor over every host's "
-        "stream (in-process for unsupervised runs); transitions emit "
+        "for 3 consecutive flush windows), 'heartbeat:age>30' (any "
+        "process silent 30s), or 'compile/recompiles_after_warmup:n>0' "
+        "(the recompilation sentinel).  AGG: p50/p95/p99/mean/max/min/"
+        "count (histograms), value (gauges), n (counters), age (heart"
+        "beat).  for=N is the hysteresis: N consecutive breaching windows "
+        "to fire, N clean ones to resolve.  Fleet aggregates — "
+        "'sum(METRIC):AGG>THR' or max(...) — fold every process's latest "
+        "window value into one fleet-wide number, evaluated by the "
+        "supervisor only (the one consumer that sees every host's "
+        "stream).  Per-process rules evaluate supervisor-side too "
+        "(in-process for unsupervised runs); transitions emit "
         "firing/resolved 'alert' events that run_report --alerts turns "
         "into a timeline and a CI exit code",
     )

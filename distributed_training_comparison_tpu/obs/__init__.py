@@ -47,9 +47,18 @@ of attempt 3".  ``obs`` is the one layer they all now report through:
   alert states; the same renderer serves ``run_report
   --export-openmetrics`` offline;
 - ``alerts.py`` — declarative ``--alert`` rules (e.g.
-  ``serve/latency_s:p99>0.25:for=3``) evaluated over flushed metric
+  ``serve/latency_s:p99>0.25:for=3``; fleet aggregates via
+  ``sum(...)``/``max(...)``, supervisor-evaluated) over flushed metric
   events and heartbeats, with hysteresis and firing/``resolved``
-  ``alert`` events ``run_report --alerts`` gates CI on.
+  ``alert`` events ``run_report --alerts`` gates CI on;
+- ``compilation.py`` — **compiler & memory observability**: every jit
+  lowering/AOT compile in the train runners and the serve engine emits a
+  registered ``compile`` event (stable cross-process fingerprint,
+  compile wall time, persistent-cache hit/miss, HLO cost/memory
+  analysis), ``compile/*`` metrics feed the exporter and ``--alert``
+  rules, a recompilation sentinel flags post-warmup compiles (serve
+  bucket churn, elastic reshapes), and per-executable dispatch sketches
+  let ``run_report --compute`` reconstruct measured MFU offline.
 
 The process holds ONE current bus and ONE current span recorder
 (``configure`` installs them; ``emit``/``span`` reach them from any
@@ -81,6 +90,15 @@ from .alerts import (
     alert_timeline,
     final_states,
     parse_alert_specs,
+)
+from .compilation import (
+    COMPILE_KIND,
+    PEAK_FLOPS_BY_DEVICE_KIND,
+    CompileMonitor,
+    ExecutableRecord,
+    fingerprint_of,
+    peak_flops_for,
+    signature_fingerprint,
 )
 from .bus import (
     ATTEMPT_ENV,
@@ -155,6 +173,13 @@ __all__ = [
     "STALL_KIND",
     "STRAGGLER_KIND",
     "ALERT_KIND",
+    "COMPILE_KIND",
+    "PEAK_FLOPS_BY_DEVICE_KIND",
+    "CompileMonitor",
+    "ExecutableRecord",
+    "fingerprint_of",
+    "peak_flops_for",
+    "signature_fingerprint",
     "KNOWN_KINDS",
     "RUN_ID_ENV",
     "ATTEMPT_ENV",

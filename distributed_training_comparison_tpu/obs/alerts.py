@@ -21,6 +21,10 @@ Spec grammar (one ``--alert`` flag per rule, repeatable)::
     heartbeat:age>30                   any process silent for 30s
                                        (evaluated on the liveness tick,
                                        not on flushes)
+    sum(serve/shed_total):value>100    FLEET aggregate: the rule's value
+                                       is the sum (or max) of the
+                                       per-process values — supervisor-
+                                       side only (below)
 
 ``AGG`` ∈ ``p50 p95 p99 mean max min count value n age``; ``CMP`` ∈
 ``> <``.  ``for=N`` (default 1) is the hysteresis: a rule fires only
@@ -29,6 +33,16 @@ consecutive clean ones — one noisy window can neither page nor
 silence.  Evaluation is per emitting process (host 1's latency breach
 must not be averaged away by host 0), with the process index carried in
 the ``alert`` payload.
+
+**Fleet aggregates** — ``sum(METRIC)`` / ``max(METRIC)`` — invert that:
+some conditions only exist fleet-wide (total shed across replicas,
+total skipped steps), so the per-process value is folded across every
+process seen so far (latest window value each) and the rule keys on the
+single source ``"fleet"``.  They are evaluated ONLY by engines
+constructed with ``fleet=True`` — the supervisor's FleetWatcher, the
+one consumer that actually sees every host's stream; an in-process
+engine evaluating a "fleet" sum over the one process it can see would
+report a fleet total that is silently one host's.
 """
 
 from __future__ import annotations
@@ -42,8 +56,11 @@ from .metrics import histogram_quantile
 ALERT_KIND = "alert"
 
 _AGGS = ("p50", "p95", "p99", "mean", "max", "min", "count", "value", "n", "age")
+_FLEET_AGGS = ("sum", "max")
 _SPEC_RE = re.compile(
-    r"^(?P<metric>[\w./-]+):(?P<agg>[a-z0-9]+)\s*(?P<cmp>[<>])\s*"
+    r"^(?:(?P<fleet>" + "|".join(_FLEET_AGGS) + r")\()?"
+    r"(?P<metric>[\w./:@-]+)(?(fleet)\))"
+    r":(?P<agg>[a-z0-9]+)\s*(?P<cmp>[<>])\s*"
     r"(?P<threshold>[-+0-9.eE]+)(?::for=(?P<for>\d+))?$"
 )
 
@@ -58,13 +75,18 @@ class AlertRule:
     def __init__(
         self, metric: str, agg: str, cmp: str, threshold: float,
         for_windows: int = 1, spec: str | None = None,
+        fleet_agg: str | None = None,
     ) -> None:
         self.metric = metric
         self.agg = agg
         self.cmp = cmp
         self.threshold = float(threshold)
         self.for_windows = max(1, int(for_windows))
-        self.spec = spec or f"{metric}:{agg}{cmp}{threshold}:for={for_windows}"
+        # "sum"/"max": aggregate the per-process values fleet-wide before
+        # comparing (supervisor-evaluated only; see the module docstring)
+        self.fleet_agg = fleet_agg
+        name = f"{fleet_agg}({metric})" if fleet_agg else metric
+        self.spec = spec or f"{name}:{agg}{cmp}{threshold}:for={for_windows}"
 
     @classmethod
     def parse(cls, spec: str) -> "AlertRule":
@@ -98,9 +120,15 @@ class AlertRule:
                 f"--alert {spec!r}: 'age' applies only to the heartbeat "
                 "pseudo-metric"
             )
+        if m.group("fleet") and agg == "age":
+            raise AlertSpecError(
+                f"--alert {spec!r}: fleet aggregates (sum/max) apply to "
+                "metric rules, not the heartbeat age pseudo-metric"
+            )
         return cls(
             m.group("metric"), agg, m.group("cmp"), threshold,
             int(m.group("for") or 1), spec=spec.strip(),
+            fleet_agg=m.group("fleet"),
         )
 
     @property
@@ -156,14 +184,33 @@ class AlertEngine:
     for the heartbeat-age rules.  State is per (rule, process); the
     engine ignores its own ``alert`` events, so wiring it as a bus
     subscriber cannot recurse.
+
+    ``fleet=True`` (the supervisor's watcher — the one consumer that
+    sees every host's stream) additionally evaluates the
+    ``sum(...)``/``max(...)`` fleet-aggregate rules: each process's
+    latest window value folds into one fleet value keyed on source
+    ``"fleet"``.  In-process engines skip those rules — a "fleet sum"
+    computed over the single process an in-process tap can see would be
+    one host's number wearing a fleet label.
     """
 
-    def __init__(self, rules, bus=None, heartbeats=None) -> None:
+    def __init__(self, rules, bus=None, heartbeats=None, fleet: bool = False) -> None:
         self.rules = list(rules)
         self.bus = bus
         # liveness source for age rules: an object with ages(now) -> dict
         # (HeartbeatEmitter or LivenessTracker)
         self.heartbeats = heartbeats
+        self.fleet = bool(fleet)
+        # fleet-aggregate inputs, per rule index: the latest value per
+        # process plus ROUND bookkeeping — a round closes when a process
+        # that already reported this round reports again, so the
+        # aggregate is evaluated once per flush round, not once per
+        # per-process flush (N hosts flushing one breaching window must
+        # advance a for=N rule by ONE, not fire it instantly), and a
+        # process that stopped reporting drops out of the fold at the
+        # next round (a dead host's stale value must not hold a sum()
+        # rule in breach forever)
+        self._fleet_state: dict[int, dict] = {}
         self._state: dict[tuple[int, object], _RuleState] = {}
         self.transitions: list[dict] = []
         # one lock over the hysteresis state: observe_event runs on
@@ -202,6 +249,15 @@ class AlertEngine:
         )
         self._ticker.start()
         return self
+
+    def reset_fleet(self) -> None:
+        """Forget the fleet-aggregate fold (the supervisor calls this at
+        every attempt start): a relaunched fleet must not inherit the
+        previous attempt's per-process values into its sums.  Rule
+        hysteresis state deliberately survives — a rule that fired in
+        attempt N still needs its clean windows to resolve."""
+        with self._lock:
+            self._fleet_state.clear()
 
     def close(self) -> None:
         if self._ticker is not None:
@@ -278,7 +334,40 @@ class AlertEngine:
             snap = metrics.get(rule.metric)
             if snap is None:
                 continue
-            self._observe_value(i, f"p{proc}", rule.value_of(snap), info)
+            value = rule.value_of(snap)
+            if rule.fleet_agg is not None:
+                if not self.fleet or value is None:
+                    continue
+                with self._lock:
+                    st = self._fleet_state.setdefault(
+                        i, {"latest": {}, "seen": set()}
+                    )
+                    boundary = proc in st["seen"]
+                    if boundary:
+                        # a round completed: only processes that reported
+                        # in it stay in the fold (dead hosts drop out)
+                        st["latest"] = {
+                            p: v for p, v in st["latest"].items()
+                            if p in st["seen"]
+                        }
+                        st["seen"] = set()
+                    st["seen"].add(proc)
+                    st["latest"][proc] = value
+                    values = list(st["latest"].values())
+                # one hysteresis observation per ROUND, and never before
+                # the first round closes: evaluating on the first flush
+                # would aggregate over however many hosts happened to
+                # have reported — a "fleet sum" that is silently one
+                # host's, the exact lie fleet rules exist to avoid (a
+                # `<` rule would false-fire on the under-count)
+                if boundary:
+                    agg = (
+                        sum(values) if rule.fleet_agg == "sum"
+                        else max(values)
+                    )
+                    self._observe_value(i, "fleet", agg, info)
+            else:
+                self._observe_value(i, f"p{proc}", value, info)
 
     def tick(self, now: float | None = None) -> None:
         """Evaluate the heartbeat-age rules against the liveness source

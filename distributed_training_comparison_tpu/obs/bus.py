@@ -71,6 +71,9 @@ KNOWN_KINDS = {
     "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
     # live fleet operations (obs/heartbeat, straggler, alerts)
     "heartbeat", "stall", "straggler", "alert",
+    # compiler observability (obs/compilation): one event per executable
+    # built, carrying the HLO cost/memory analysis + cache outcome
+    "compile",
 }
 
 

@@ -54,6 +54,12 @@ _NON_LIVENESS_KINDS = {
 # order of magnitude of silence
 SLOW_AFTER_BEATS = 3.0
 DEAD_AFTER_BEATS = 10.0
+# livelock threshold: a process whose beats arrive ON schedule but whose
+# step has not advanced for this many consecutive beats is "stuck" — the
+# failure age-based classification is blind to (the host is alive and
+# beating; it just isn't training).  Spans several beats so a long eval
+# or checkpoint fetch between chunks doesn't page.
+STUCK_AFTER_BEATS = 5
 
 
 class HeartbeatEmitter:
@@ -132,12 +138,22 @@ class LivenessTracker:
     life (reported as state ``recovered``).  One dict per transition —
     a host stuck in ``slow`` produces nothing until it worsens or
     recovers, so the emitted ``stall`` stream never flaps.
+
+    A fourth state catches the **livelock** the age states cannot:
+    ``stuck`` — heartbeats arriving on schedule (age says ok) while the
+    step they carry has not advanced for ``stuck_after_beats``
+    consecutive beats.  A wedged collective stops the beats (→ slow/
+    dead), but a retry loop, a hung data source, or a deadlocked
+    producer keeps the trainer's watchdog thread touching chunk
+    boundaries at step N forever — alive, beating, not training.  One
+    event on the transition in, ``recovered`` when the step advances.
     """
 
     def __init__(
         self, heartbeat_s: float = 10.0,
         slow_after_s: float | None = None,
         dead_after_s: float | None = None,
+        stuck_after_beats: int = STUCK_AFTER_BEATS,
     ) -> None:
         interval = max(float(heartbeat_s), 1e-9)
         self.slow_after_s = (
@@ -148,6 +164,7 @@ class LivenessTracker:
             float(dead_after_s) if dead_after_s is not None
             else DEAD_AFTER_BEATS * interval
         )
+        self.stuck_after_beats = max(1, int(stuck_after_beats))
         # process -> {"last_seen", "state", "epoch", "step", "attempt"}
         self._procs: dict[int, dict] = {}
 
@@ -166,7 +183,8 @@ class LivenessTracker:
         now = time.monotonic() if now is None else now
         rec = self._procs.setdefault(
             p, {"last_seen": now, "state": "ok", "epoch": None, "step": None,
-                "attempt": int(ev.get("attempt", 0)), "beats": 0}
+                "attempt": int(ev.get("attempt", 0)), "beats": 0,
+                "beats_at_step": 0}
         )
         rec["last_seen"] = now
         rec["attempt"] = int(ev.get("attempt", rec["attempt"] or 0))
@@ -175,6 +193,13 @@ class LivenessTracker:
             if "epoch" in ev:
                 rec["epoch"] = ev["epoch"]
             if "step" in ev:
+                # livelock bookkeeping: count consecutive beats carrying
+                # the SAME step; any change (forward progress, or a
+                # rollback replaying earlier steps) resets the count
+                if ev["step"] == rec["step"]:
+                    rec["beats_at_step"] += 1
+                else:
+                    rec["beats_at_step"] = 1
                 rec["step"] = ev["step"]
 
     def ages(self, now: float | None = None) -> dict[str, float]:
@@ -202,6 +227,10 @@ class LivenessTracker:
                 state = "dead"
             elif age > self.slow_after_s:
                 state = "slow"
+            elif rec["beats_at_step"] >= self.stuck_after_beats:
+                # beats on schedule, step frozen: livelock — distinct from
+                # slow/dead (those mean the beats themselves stopped)
+                state = "stuck"
             else:
                 state = "ok"
             if state == "dead" and not rec["beats"]:

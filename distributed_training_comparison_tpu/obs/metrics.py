@@ -42,20 +42,31 @@ METRICS_KIND = "metrics"  # the bus event kind every flush emits
 
 
 class Counter:
-    """A monotonically increasing count (events, bytes, retries)."""
+    """A monotonically increasing count (events, bytes, retries).
+
+    A counter that has never fired stays out of the flush events (no
+    dead weight), but once it HAS fired it keeps reporting — explicit
+    ``n: 0`` deltas on clean windows — because the alert engine's
+    window rules resolve on observations, not on absences: a
+    ``train/skipped_steps:n>0`` (or the recompilation sentinel's
+    ``compile/recompiles_after_warmup:n>0``) rule that fired must see
+    the clean windows to ever emit its ``resolved`` transition.
+    """
 
     def __init__(self, name: str) -> None:
         self.name = name
         self._lock = threading.Lock()
         self._n = 0
+        self._ever = False
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
             self._n += int(n)
+            self._ever = True
 
     def snapshot(self, reset: bool = True) -> dict | None:
         with self._lock:
-            n, dirty = self._n, self._n != 0
+            n, dirty = self._n, self._ever
             if reset:
                 self._n = 0
         if not dirty:

@@ -18,6 +18,10 @@ carries the latest sampled values regardless of the cadence)::
     res/host_rss_bytes                          (/proc/self/statm)
     res/open_fds                                (/proc/self/fd)
     res/disk_free_bytes                         (statvfs of the ckpt root)
+    res/live_arrays · res/live_array_bytes      (jax.live_arrays() census,
+        guarded through _compat — with the per-executable analysis totals
+        of the compile ledger this answers "where did HBM go": arrays the
+        program still holds vs what the executables themselves reserve)
 
 Every read is wrapped: a missing /proc, an unreadable mount, or a backend
 without memory stats silently drops that gauge — resource telemetry must
@@ -31,7 +35,7 @@ import shutil
 import time
 from pathlib import Path
 
-from .._compat import device_memory_stats
+from .._compat import device_memory_stats, live_arrays
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
@@ -57,6 +61,26 @@ def disk_free_bytes(path: str | Path) -> int | None:
         return shutil.disk_usage(str(path)).free
     except OSError:
         return None
+
+
+def live_array_census() -> tuple[int, int] | None:
+    """``(count, total_bytes)`` over ``jax.live_arrays()`` — the array
+    side of the HBM ledger.  Donated buffers linger in the list as
+    deleted arrays whose attribute reads raise; they hold no memory and
+    are skipped, not counted.  None when the API is absent."""
+    arrays = live_arrays()
+    if arrays is None:
+        return None
+    count = 0
+    total = 0
+    for a in arrays:
+        try:
+            nbytes = a.nbytes
+        except Exception:  # deleted (donated) array — owns nothing
+            continue
+        count += 1
+        total += int(nbytes)
+    return count, total
 
 
 class ResourceSampler:
@@ -102,6 +126,10 @@ class ResourceSampler:
             free = disk_free_bytes(self.ckpt_root)
             if free is not None:
                 out["res/disk_free_bytes"] = float(free)
+        census = live_array_census()
+        if census is not None:
+            out["res/live_arrays"] = float(census[0])
+            out["res/live_array_bytes"] = float(census[1])
         stats = device_memory_stats(self._resolve_device())
         if stats:
             used = stats.get("bytes_in_use")

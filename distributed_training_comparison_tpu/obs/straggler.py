@@ -31,7 +31,14 @@ from .metrics import histogram_quantile, merge_histograms
 
 STRAGGLER_KIND = "straggler"
 
-# the phase sketches utils/meters.py flushes, one sample per chunk
+# The phase sketches utils/meters.py flushes, one sample per chunk.
+# Deliberately ONLY the clean `step/{phase}_s` series: the meter routes a
+# sample whose span contained a jit compile (the compile monitor's taint
+# flag) into `step/{phase}_compile_s` instead, so first-dispatch and
+# recompile costs never enter the p95 comparison — without the split, a
+# warm-resumed host (persistent cache served its first dispatch) reads
+# as faster than peers that genuinely compiled, and a host that hit a
+# recompile cliff reads as a straggler for the rest of the attempt.
 STEP_PHASES = ("h2d_wait", "dispatch", "compute")
 PHASE_METRICS = {f"step/{p}_s": p for p in STEP_PHASES}
 

@@ -128,6 +128,33 @@ def needs_collective_fetch(tree) -> bool:
     )
 
 
+def sharding_desc(leaf) -> str:
+    """A stable, process-independent description of a leaf's placement —
+    the sharding term of the compile-event fingerprint
+    (``obs/compilation.py``): partition spec + mesh axis sizes for
+    named-sharded arrays, ``replicated``/``single`` for the trivial
+    layouts, ``host`` for anything not yet on a device.  Device ids and
+    object identities never appear, so every process of a fleet (and a
+    relaunch of the same topology) describes the same array the same
+    way — the property that lets ``run_report --compute`` join compile
+    events across hosts."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return "host"
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is not None and mesh is not None:
+        try:
+            return f"{spec}/mesh{dict(mesh.shape)}"
+        except Exception:
+            return str(spec)
+    if getattr(sharding, "is_fully_replicated", False):
+        return "replicated"
+    if type(sharding).__name__ == "SingleDeviceSharding":
+        return "single"
+    return type(sharding).__name__
+
+
 def host_local_batch_slice(global_batch_size: int) -> int:
     """This host's share of the global batch (reference analogue:
     ``batch_size //= ngpus_per_node``, ``src/ddp/trainer.py:34`` — but per

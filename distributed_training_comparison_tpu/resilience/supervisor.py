@@ -287,6 +287,10 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
         obs.parse_alert_specs(getattr(hparams, "alert", None)),
         bus=bus,
         heartbeats=tracker,
+        # the supervisor sees every host's stream, so it is the ONE
+        # evaluator of fleet-aggregate rules (sum(...)/max(...) specs);
+        # per-process rules evaluate here too, as before
+        fleet=True,
     )
     watcher = (
         obs.FleetWatcher(hparams.ckpt_path, bus, tracker=tracker, engine=engine)
@@ -304,10 +308,14 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
 
     def on_event(kind: str, **payload):
         bus.emit(kind, **payload)
-        if kind == "attempt_start" and tracker is not None:
-            # fresh liveness per attempt: the previous attempt's death and
-            # the backoff gap must not read as this one's fleet stalling
-            tracker.reset()
+        if kind == "attempt_start":
+            # fresh liveness + fleet-aggregate folds per attempt: the
+            # previous attempt's death and the backoff gap must not read
+            # as this one's fleet stalling, and its processes' last
+            # window values must not hold a sum() rule in breach
+            if tracker is not None:
+                tracker.reset()
+            engine.reset_fleet()
         if kind == "attempt_end" and obs_enabled:
             # the black-box pull: decode every host's mmap flight ring
             # under the ckpt root (version dirs included) into ONE

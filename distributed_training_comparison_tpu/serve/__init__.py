@@ -55,7 +55,7 @@ __all__ = [
 ]
 
 
-def build_engine(hparams, mesh=None) -> ServeEngine:
+def build_engine(hparams, mesh=None, monitor=None) -> ServeEngine:
     """A ``ServeEngine`` from a parsed flag namespace (``config.py``).
 
     Model construction mirrors the Trainer's flag mapping (dtype from
@@ -108,6 +108,7 @@ def build_engine(hparams, mesh=None) -> ServeEngine:
         buckets=getattr(hparams, "serve_buckets", DEFAULT_BUCKETS),
         precision=compute,
         image_size=image_size,
+        monitor=monitor,
     )
 
 
@@ -135,7 +136,24 @@ def serve_main(hparams) -> dict:
             "launch would dispatch desynchronized bucket programs)"
         )
     logger = setup_logger(None, is_main_process=is_main_process())
-    engine = build_engine(hparams)
+    # obs wiring happens BEFORE the engine exists so the warmup compiles
+    # are observed: the bus buffers pre-bind emits and flushes them when
+    # the ckpt root binds below, so nothing from engine construction is
+    # lost.  The compile monitor gives every bucket compile a `compile`
+    # event + compile/* metrics, and — once warmup() marks it warm — a
+    # bucket compiled mid-serving (bucket churn, the recompile cliff)
+    # trips the compile/recompiles_after_warmup sentinel --alert rules
+    # can page on.
+    from .. import obs
+
+    bus = None
+    if getattr(hparams, "obs", True):
+        bus = obs.current_bus()
+    registry = obs.MetricRegistry()
+    monitor = obs.CompileMonitor(
+        bus=bus, registry=registry, enabled=bus is not None
+    )
+    engine = build_engine(hparams, monitor=monitor)
     ck = engine.checkpoint_meta
     logger.info(
         f"[serve] model {hparams.model}, mesh {dict(engine.mesh.shape)}, "
@@ -156,21 +174,17 @@ def serve_main(hparams) -> dict:
         image_size=engine.image_size,
         seed=hparams.seed,
     )
-    # bind the run-event bus up front so the periodic `metrics` events the
-    # session emits (latency-histogram deltas + queue gauges — the live SLO
-    # feed `run_report --follow` tails) land in the ckpt root's events.jsonl
-    from .. import obs
-
-    bus = None
-    if getattr(hparams, "obs", True):
-        bus = obs.current_bus()
+    # bind the run-event bus so the buffered warmup `compile` events and
+    # the periodic `metrics` events the session emits (latency-histogram
+    # deltas + queue gauges — the live SLO feed `run_report --follow`
+    # tails) land in the ckpt root's events.jsonl
+    if bus is not None:
         bus.bind_dir(hparams.ckpt_path)
     # live operations for the serving path: the latency histogram and
     # queue/shed gauges mirror into a metric registry the OpenMetrics
     # endpoint renders (--metrics-port), and the --alert rules evaluate
     # in-process over the periodic `metrics` emits (serving runs
     # unsupervised, so there is no fleet watcher to do it).
-    registry = obs.MetricRegistry()
     alert_engine = None
     specs = getattr(hparams, "alert", None)
     if specs and bus is not None:
@@ -219,6 +233,11 @@ def serve_main(hparams) -> dict:
             bus.unsubscribe(alert_engine.observe_event)
     metrics.log_summary(logger)
     report["engine"] = engine.stats()
+    if bus is not None:
+        # one closing flush puts the session's compile/* counters and the
+        # per-bucket exec/... dispatch sketches on the event stream — the
+        # rows run_report --compute renders for a serving session
+        registry.flush(bus)
     if is_main_process():
         metrics.write_tensorboard(Path(hparams.ckpt_path) / "serve-tb")
         # one summary record on the unified run-event bus: a serving

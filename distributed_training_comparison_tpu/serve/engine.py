@@ -75,6 +75,7 @@ class ServeEngine:
         image_size: int = 32,
         mean=CIFAR100_MEAN,
         std=CIFAR100_STD,
+        monitor=None,
     ) -> None:
         if not buckets:
             raise ValueError("serve buckets must be non-empty")
@@ -146,10 +147,16 @@ class ServeEngine:
             ),
         ).shape[-1]
         self._lock = threading.RLock()
-        self._compiled: dict[int, object] = {}
+        # bucket -> (compiled executable, compile-monitor record | None)
+        self._compiled: dict[int, tuple] = {}
         self.compile_count = 0
         self.cache_hits = 0
         self.bucket_counts: dict[int, int] = {b: 0 for b in self.buckets}
+        # compile observability (obs/compilation.py CompileMonitor): every
+        # bucket compile emits a `compile` event with its cost/memory
+        # analysis, and a bucket compiled after warmup() — the serve
+        # bucket-churn failure mode — trips the recompilation sentinel
+        self._monitor = monitor
 
     # ------------------------------------------------------------ program
     def _forward(self, variables, images_u8):
@@ -170,10 +177,10 @@ class ServeEngine:
         )
 
     def _executable(self, bucket: int):
-        exe = self._compiled.get(bucket)
-        if exe is not None:
+        entry = self._compiled.get(bucket)
+        if entry is not None:
             self.cache_hits += 1
-            return exe
+            return entry
         shape = jax.ShapeDtypeStruct(
             (bucket, self.image_size, self.image_size, 3), jnp.uint8
         )
@@ -191,10 +198,24 @@ class ServeEngine:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            exe = fn.lower(self.variables, shape).compile()
-        self._compiled[bucket] = exe
+            build = lambda: fn.lower(self.variables, shape).compile()  # noqa: E731
+            if self._monitor is not None:
+                exe, rec = self._monitor.aot_compile(
+                    "serve_predict",
+                    build,
+                    parts=(
+                        f"bucket={bucket}",
+                        f"image={self.image_size}",
+                        f"dtype={jnp.dtype(self.compute_dtype).name}",
+                        f"mesh={dict(self.mesh.shape)}",
+                    ),
+                )
+            else:
+                exe, rec = build(), None
+        entry = (exe, rec)
+        self._compiled[bucket] = entry
         self.compile_count += 1
-        return exe
+        return entry
 
     # ------------------------------------------------------------- public
     @property
@@ -211,17 +232,31 @@ class ServeEngine:
             "chunk before dispatch (predict_logits does this for you)"
         )
 
-    def warmup(self) -> None:
-        """Compile every bucket up front — after this, serving traffic of
-        any ragged size runs with zero compiles (asserted by tests via
-        ``stats()``)."""
+    def warmup(self, buckets: Sequence[int] | None = None) -> None:
+        """Compile every bucket (or the given subset) up front — after
+        this, serving traffic of the warmed sizes runs with zero compiles
+        (asserted by tests via ``stats()``).
+
+        A subset warmup is the deliberate deployment shape "warm the
+        buckets this replica's expected traffic uses"; it also marks the
+        compile monitor warm, so a flash crowd landing on an unwarmed
+        bucket — a compile cliff in the middle of live serving — trips
+        the recompilation sentinel instead of passing as a slow request.
+        """
         with self._lock:
-            for b in self.buckets:
+            for b in buckets if buckets is not None else self.buckets:
+                if b not in self.buckets:
+                    raise ValueError(
+                        f"cannot warm bucket {b}: not in the ladder "
+                        f"{self.buckets}"
+                    )
                 self._run_bucket(
                     np.zeros(
                         (b, self.image_size, self.image_size, 3), np.uint8
                     )
                 )
+        if self._monitor is not None:
+            self._monitor.warm()
 
     def _run_bucket(self, images: np.ndarray) -> np.ndarray:
         """Run one <=max_bucket chunk: pad to its bucket, execute, unpad."""
@@ -232,11 +267,21 @@ class ServeEngine:
                 (bucket - n, *images.shape[1:]), dtype=images.dtype
             )
             images = np.concatenate([images, pad], axis=0)
-        exe = self._executable(bucket)
+        exe, rec = self._executable(bucket)
         self.bucket_counts[bucket] += 1
         staged = jax.device_put(images, self._input_sharding(bucket))
-        logits = exe(self.variables, staged)
-        return np.asarray(logits)[:n]
+        if self._monitor is not None:
+            # per-executable dispatch span: the denominator of the
+            # measured per-bucket MFU run_report --compute reconstructs.
+            # The device→host fetch is INSIDE the span — `exe(...)` is an
+            # async enqueue (serve does not donate its variables, nothing
+            # blocks the call), so a span around it alone would record
+            # ~0.1 ms of launch latency and MFU would divide by nothing
+            with self._monitor.time_dispatch(rec):
+                logits = np.asarray(exe(self.variables, staged))
+        else:
+            logits = np.asarray(exe(self.variables, staged))
+        return logits[:n]
 
     def predict_logits(self, images: np.ndarray) -> np.ndarray:
         """uint8 NHWC batch (any size) → fp32 logits, chunked over buckets."""

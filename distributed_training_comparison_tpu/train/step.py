@@ -47,15 +47,34 @@ from .state import TrainState
 
 Metrics = dict[str, jnp.ndarray]
 
-def _donated_jit(fun, *, donate_argnums, **jit_kw):
+
+def _observed(jitted, monitor, name, sentinel=True):
+    """Route a jitted runner through the compile monitor when one is
+    wired (obs/compilation.py): every distinct executable it builds then
+    emits a ``compile`` event with its HLO cost/memory analysis, and
+    dispatches are accounted per executable.  ``monitor=None`` (tests,
+    library embedders, ``--no-obs``) returns the function unchanged."""
+    if monitor is None:
+        return jitted
+    return monitor.instrument(jitted, name, sentinel=sentinel)
+
+
+def _donated_jit(fun, *, donate_argnums, monitor=None, name=None, **jit_kw):
     """``jax.jit`` with buffer donation whose executables are never WRITTEN
     to the persistent compile cache: donated executables deserialized from
     the on-disk cache misbehave on this jax's CPU backend (segfaults /
     silently corrupted carries — see ``_compat.donated_cache_write_barred``).
     Barring the write means no process can ever load one.  The context
     wraps every call (compilation happens at the first call per shape);
-    steady-state calls pay only a thread-local config flip."""
-    jitted = jax.jit(fun, donate_argnums=donate_argnums, **jit_kw)
+    steady-state calls pay only a thread-local config flip.
+
+    The compile monitor wraps INSIDE this context, so an observed AOT
+    compile of a donated runner happens under the same write bar as the
+    jit path it replaces."""
+    jitted = _observed(
+        jax.jit(fun, donate_argnums=donate_argnums, **jit_kw),
+        monitor, name or getattr(fun, "__name__", "donated"),
+    )
 
     def call(*args):
         # An input uint8 chunk can rarely alias any float output, so a
@@ -281,6 +300,7 @@ def make_train_step(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    monitor=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """Build the compiled ``(state, images_u8, labels, key) -> (state, metrics)``.
 
@@ -301,10 +321,13 @@ def make_train_step(
     # No buffer donation here: this per-step path serves benchmarks and
     # tests that re-read their inputs after the call (the scanned runners
     # donate — they own the train loop's hot path; see make_epoch_runner).
-    return jax.jit(
-        core,
-        in_shardings=(state_sh, data_shard, data_shard, repl),
-        out_shardings=(state_sh, repl),
+    return _observed(
+        jax.jit(
+            core,
+            in_shardings=(state_sh, data_shard, data_shard, repl),
+            out_shardings=(state_sh, repl),
+        ),
+        monitor, "train_step",
     )
 
 
@@ -344,6 +367,7 @@ def make_eval_step(
     precision: str = "fp32",
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
+    monitor=None,
 ) -> Callable[..., Metrics]:
     """Compiled eval step with padding mask.
 
@@ -354,7 +378,13 @@ def make_eval_step(
     """
     repl = replicated_sharding(mesh)
     core = _make_eval_core(mesh, precision, mean, std)
-    return jax.jit(core, out_shardings=repl)
+    # sentinel=False: eval programs legitimately compile one executable
+    # per split shape whenever a new split first evaluates — steady state
+    # does not mean "no eval compiles", unlike the train/serve hot paths
+    return _observed(
+        jax.jit(core, out_shardings=repl), monitor, "eval_step",
+        sentinel=False,
+    )
 
 
 def make_eval_runner(
@@ -364,6 +394,8 @@ def make_eval_runner(
     precision: str = "fp32",
     mean=CIFAR100_MEAN,
     std=CIFAR100_STD,
+    monitor=None,
+    name: str = "eval_runner",
 ) -> Callable[..., Metrics]:
     """A whole eval split as ONE compiled ``lax.scan`` over padded batches.
 
@@ -392,7 +424,12 @@ def make_eval_runner(
         )
         return totals
 
-    return jax.jit(run, out_shardings=repl)
+    # sentinel=False: one executable per split shape is the design (val
+    # and test differ), and the test split's first compile may land long
+    # after the trainer declared steady state
+    return _observed(
+        jax.jit(run, out_shardings=repl), monitor, name, sentinel=False
+    )
 
 
 def _step_fault_scale(i, fault):
@@ -420,6 +457,7 @@ def make_chunk_runner(
     fwd_bwd=None,
     fault_injection: bool = False,
     donate: bool = True,
+    monitor=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """K loader steps as ONE compiled ``lax.scan`` dispatch (host streaming).
 
@@ -479,10 +517,15 @@ def make_chunk_runner(
         return _donated_jit(
             run,
             donate_argnums=(0, 1, 2),
+            monitor=monitor,
+            name="chunk_runner",
             in_shardings=in_sh,
             out_shardings=(state_sh, repl),
         )
-    return jax.jit(run, in_shardings=in_sh, out_shardings=(state_sh, repl))
+    return _observed(
+        jax.jit(run, in_shardings=in_sh, out_shardings=(state_sh, repl)),
+        monitor, "chunk_runner",
+    )
 
 
 def make_device_chunk_runner(
@@ -499,6 +542,7 @@ def make_device_chunk_runner(
     fwd_bwd=None,
     fault_injection: bool = False,
     donate: bool = True,
+    monitor=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """``chunk_steps`` steps of a device-resident epoch as ONE scanned
     dispatch — the chunked form of ``make_epoch_runner``.
@@ -560,11 +604,19 @@ def make_device_chunk_runner(
         run = lambda state, images, labels, key, epoch, start: (  # noqa: E731
             _run(state, images, labels, key, epoch, start, None)
         )
+    # the chunk length is a STATIC of this runner (two runners over the
+    # same split take identically-shaped args) — it must be part of the
+    # observed family name or the full-chunk and remainder executables
+    # would collide on one fingerprint
+    obs_name = f"device_chunk_runner@k{chunk_steps}"
     if donate:
         return _donated_jit(
-            run, donate_argnums=(0,), out_shardings=(state_sh, repl)
+            run, donate_argnums=(0,), monitor=monitor,
+            name=obs_name, out_shardings=(state_sh, repl),
         )
-    return jax.jit(run, out_shardings=(state_sh, repl))
+    return _observed(
+        jax.jit(run, out_shardings=(state_sh, repl)), monitor, obs_name
+    )
 
 
 def make_epoch_runner(
@@ -580,6 +632,7 @@ def make_epoch_runner(
     fwd_bwd=None,
     fault_injection: bool = False,
     donate: bool = True,
+    monitor=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -638,6 +691,9 @@ def make_epoch_runner(
         )
     if donate:
         return _donated_jit(
-            run, donate_argnums=(0,), out_shardings=(state_sh, repl)
+            run, donate_argnums=(0,), monitor=monitor,
+            name="epoch_runner", out_shardings=(state_sh, repl),
         )
-    return jax.jit(run, out_shardings=(state_sh, repl))
+    return _observed(
+        jax.jit(run, out_shardings=(state_sh, repl)), monitor, "epoch_runner"
+    )

@@ -374,12 +374,14 @@ class Trainer:
                 grad_accum=self.grad_accum,
                 fwd_bwd=self.train_fwd_bwd,
                 fault_injection=self._step_faults,
+                monitor=self.compile_monitor,
             )
         # whole-split scanned eval: one dispatch per validate()/test() call
         # (one executable per split shape), matching the train path's
         # one-dispatch-per-epoch design
         self.eval_runner = make_eval_runner(
-            self.mesh, hparams.batch_size, precision=self.precision
+            self.mesh, hparams.batch_size, precision=self.precision,
+            monitor=self.compile_monitor,
         )
         if test_stats == (CIFAR100_MEAN, CIFAR100_STD):
             self.test_eval_runner = self.eval_runner  # same constants
@@ -390,6 +392,8 @@ class Trainer:
                 precision=self.precision,
                 mean=test_stats[0],
                 std=test_stats[1],
+                monitor=self.compile_monitor,
+                name="test_eval_runner",
             )
 
         # --- run dir, logging, provenance (process-0 only)
@@ -630,6 +634,16 @@ class Trainer:
         self.metrics = obs.MetricRegistry(
             flush_steps=getattr(hparams, "metrics_flush_steps", 50)
         )
+        # compiler observability (obs/compilation.py): every jit
+        # lowering/compile of this attempt's runners emits a `compile`
+        # event (fingerprint, wall time, persistent-cache outcome, HLO
+        # cost/memory analysis) and per-executable dispatch sketches —
+        # the substrate of run_report --compute's measured-MFU table.
+        # Disabled with --no-obs: the runners then dispatch exactly as
+        # before and the event stream carries nothing new.
+        self.compile_monitor = obs.CompileMonitor(
+            bus=self.bus, registry=self.metrics, enabled=self._obs_enabled
+        )
         # --- live fleet operations (obs/): bounded-cadence heartbeats
         # (liveness the supervisor's watcher classifies slow vs dead),
         # resource gauges sampled once per flush, an optional per-process
@@ -729,8 +743,11 @@ class Trainer:
         pre-donation design's copy on EVERY dispatch.
         """
         if self._snapshot_fn is None:
-            self._snapshot_fn = jax.jit(
-                lambda s: jax.tree_util.tree_map(jnp.copy, s)
+            # sentinel=False: the snapshot program compiles whenever the
+            # FIRST throttled save happens — legitimately after warmup
+            self._snapshot_fn = self.compile_monitor.instrument(
+                jax.jit(lambda s: jax.tree_util.tree_map(jnp.copy, s)),
+                "state_snapshot", sentinel=False,
             )
         return self._snapshot_fn(state)
 
@@ -749,6 +766,7 @@ class Trainer:
                 grad_accum=self.grad_accum,
                 fwd_bwd=self.train_fwd_bwd,
                 fault_injection=self._step_faults,
+                monitor=self.compile_monitor,
             )
             self._device_runners[take] = runner
         return runner
@@ -1008,6 +1026,13 @@ class Trainer:
                     self.goodput.add("stall", stall)
             if self._preempt_due(epoch):
                 self._preempt_exit(epoch, state_ref, want_last, sync_fetch)
+            if epoch == self.start_epoch:
+                # steady state for the recompilation sentinel: the first
+                # full epoch built every hot-path executable (chunk runner
+                # + remainder, val eval) — a sentinel-tracked compile from
+                # here on is bucket churn / an unexpected reshape, and
+                # bumps compile/recompiles_after_warmup
+                self.compile_monitor.warm()
             epoch += 1
             if bar is not None:
                 bar.update(1)
@@ -1162,7 +1187,10 @@ class Trainer:
         COLLECTIVE under multi-host — reached identically by every process).
         One scalar device→host read; see health/desync.py."""
         if self._fingerprint_fn is None:
-            self._fingerprint_fn = jax.jit(param_fingerprint)
+            self._fingerprint_fn = self.compile_monitor.instrument(
+                jax.jit(param_fingerprint), "param_fingerprint",
+                sentinel=False,
+            )
         return check_desync(
             float(self._fingerprint_fn(self.state.params)), inject=inject
         )
@@ -1534,8 +1562,13 @@ class Trainer:
             )
             # the step arg on the dispatch span is the join key run_report
             # --xplane matches against the device capture's
-            # StepTraceAnnotations (same id as the annotation above)
-            with ann, meter.phase("dispatch", step=epoch * steps + done):
+            # StepTraceAnnotations (same id as the annotation above);
+            # taint= keeps a compile-bearing dispatch sample out of the
+            # straggler-scored step/dispatch_s sketch
+            with ann, meter.phase(
+                "dispatch", taint=self.compile_monitor.take_taint,
+                step=epoch * steps + done,
+            ):
                 if fault is not None:
                     self.state, metrics = runner(*args, fault)
                 else:
@@ -1673,8 +1706,12 @@ class Trainer:
                     if self._profiling
                     else nullcontext()
                 )
-                # step arg = the --xplane join key (see the device loop)
-                with ann, meter.phase("dispatch", step=epoch * steps + start):
+                # step arg = the --xplane join key (see the device loop);
+                # taint= excludes compile-bearing samples (see there too)
+                with ann, meter.phase(
+                    "dispatch", taint=self.compile_monitor.take_taint,
+                    step=epoch * steps + start,
+                ):
                     args = (
                         self.state, batch["x"], batch["y"],
                         epoch_key, jnp.asarray(start),

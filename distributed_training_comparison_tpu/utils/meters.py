@@ -76,28 +76,46 @@ class StepTimeMeter:
         self.seconds = {p: 0.0 for p in self.PHASES}
         self.chunks = 0
 
-    def add(self, phase: str, secs: float) -> None:
+    def add(self, phase: str, secs: float, compiled: bool = False) -> None:
+        """Account one phase interval.  ``compiled=True`` marks a sample
+        whose span contained a jit compile: it still counts into the
+        epoch totals (the wall clock really passed), but lands in a
+        separate ``step/{phase}_compile_s`` sketch so the cross-host
+        straggler scoring — which reads ``step/{phase}_s`` only — never
+        judges a host by its compiles.  Without the exclusion a
+        warm-resumed host (persistent cache serves its first dispatch)
+        reads as faster than peers that genuinely compiled."""
         secs = max(0.0, float(secs))
         self.seconds[phase] += secs
         if self.metrics is not None:
-            self.metrics.histogram(f"step/{phase}_s").record(secs)
+            suffix = "_compile_s" if compiled else "_s"
+            self.metrics.histogram(f"step/{phase}{suffix}").record(secs)
 
     @contextmanager
-    def phase(self, name: str, **attrs):
+    def phase(self, name: str, taint=None, **attrs):
         # attrs ride into the span's args — the trainer stamps the chunk's
         # global step onto `dispatch`, the join key run_report --xplane
-        # matches against the device capture's StepTraceAnnotations
+        # matches against the device capture's StepTraceAnnotations.
+        # ``taint`` — optional zero-arg read-and-clear callable (the
+        # compile monitor's take_taint): consulted once on ENTRY to drop
+        # any stale flag (an eval/snapshot compile between phases must
+        # not taint the next dispatch) and once when the span closes —
+        # True then means a compile happened INSIDE this span, and the
+        # sample reroutes to the compile-bearing sketch (see ``add``).
         ctx = (
             self.tracer.span(name, **attrs)
             if self.tracer is not None
             else nullcontext()
         )
+        if taint:
+            taint()
         t0 = time.perf_counter()
         try:
             with ctx:
                 yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.add(name, dt, compiled=bool(taint()) if taint else False)
 
     def note_chunk(self) -> None:
         self.chunks += 1

@@ -1,0 +1,718 @@
+"""Compiler & memory observability (ISSUE 8): compile events with the
+HLO cost/memory ledger, the recompilation sentinel, measured per-
+executable MFU in run_report --compute, and the satellites that ride
+along (livelock-aware "stuck" stall classification, fleet-aggregate
+alert rules, compile-tainted straggler-sample exclusion, the
+jax.live_arrays census).
+
+The load-bearing properties pinned here:
+
+- every distinct executable an instrumented function builds emits ONE
+  schema-valid ``compile`` event with a fingerprint that is a pure
+  function of (family, abstract shapes/dtypes/shardings) — identical
+  across processes, distinct across signatures;
+- the persistent compile cache's hit/miss outcome is distinguished, and
+  a jax without the analysis APIs degrades to events without flops —
+  never to a crash in the training path;
+- the sentinel flags exactly the compiles that happen after ``warm()``
+  on sentinel-tracked families — the serve-bucket-miss e2e drives a
+  real ``--alert`` rule through firing and resolved;
+- ``run_report --compute`` reconstructs the per-executable table
+  (compiles, cache, compile time, flops, peak HBM, measured MFU) from
+  the event stream alone.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import run_report  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_training_comparison_tpu import obs
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.obs import compilation as compilation_mod
+from distributed_training_comparison_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    AlertSpecError,
+)
+from distributed_training_comparison_tpu.obs.heartbeat import LivenessTracker
+from distributed_training_comparison_tpu.obs.metrics import (
+    MetricRegistry,
+    merge_metric_events,
+)
+from distributed_training_comparison_tpu.obs.resource import (
+    ResourceSampler,
+    live_array_census,
+)
+from distributed_training_comparison_tpu.obs.straggler import (
+    straggler_findings,
+)
+from distributed_training_comparison_tpu.utils import StepTimeMeter
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture
+def monitor_env():
+    """A live bus + registry + monitor, torn down afterwards so the
+    process-current bus never leaks between tests."""
+    bus = obs.configure(run_id=obs.new_run_id(), persist=True)
+    registry = MetricRegistry(flush_steps=1)
+    monitor = obs.CompileMonitor(bus=bus, registry=registry)
+    yield bus, registry, monitor
+    obs.reset()
+
+
+def _compile_events(bus):
+    return [e for e in bus.ring_events() if e["kind"] == "compile"]
+
+
+# ------------------------------------------------------- events + schema
+
+
+def test_compile_event_schema_and_dedup(monitor_env):
+    bus, registry, monitor = monitor_env
+    fn = monitor.instrument(jax.jit(lambda x: (x * 2.0).sum()), "double")
+    x = np.ones((8, 8), np.float32)
+    assert float(fn(x)) == 128.0
+    assert float(fn(x)) == 128.0  # same signature: no second compile
+    events = _compile_events(bus)
+    assert len(events) == 1
+    ev = events[0]
+    assert obs.validate_event(ev) == []
+    p = ev["payload"]
+    assert p["name"] == "double"
+    assert len(p["fingerprint"]) == 16
+    assert p["compile_s"] > 0
+    assert p["cache"] in ("hit", "miss", "off", "unknown")
+    assert p["compiles_of_fingerprint"] == 1
+    assert p["recompile_after_warmup"] is False
+    # device identity comes from the EXECUTABLE's own device set (a
+    # plain unsharded jit compiles for one device, not the 8-device
+    # default backend) — the honest MFU denominator
+    assert p["platform"] == "cpu" and p["devices"] == 1
+    # this jax HAS the analyses: the ledger numbers must be present
+    assert p["flops"] > 0
+    assert p["peak_bytes"] > 0 and p["argument_bytes"] > 0
+    # a new signature is a new executable with a distinct fingerprint
+    fn(np.ones((4, 4), np.float32))
+    events = _compile_events(bus)
+    assert len(events) == 2
+    assert events[1]["payload"]["fingerprint"] != p["fingerprint"]
+
+
+def test_compile_metrics_ride_the_registry(monitor_env):
+    bus, registry, monitor = monitor_env
+    fn = monitor.instrument(jax.jit(lambda x: x + 1), "bump")
+    fn(np.zeros(4, np.float32))
+    fn(np.zeros(4, np.float32))
+    fn(np.zeros(8, np.float32))
+    snaps = registry.snapshot(reset=False)
+    assert snaps["compile/total"]["n"] == 2
+    assert snaps["compile/by/bump"]["n"] == 2
+    assert snaps["compile/time_s"]["count"] == 2
+    assert snaps["compile/executables"]["value"] == 2.0
+    assert snaps["compile/peak_hbm_bytes"]["value"] > 0
+    # per-executable dispatch sketches: count == dispatches through each
+    dispatch = {
+        k: v["count"] for k, v in snaps.items()
+        if k.startswith("exec/bump:")
+    }
+    assert sorted(dispatch.values()) == [1, 2]
+
+
+def test_fingerprint_stable_across_processes():
+    """Two fresh interpreters describing the same (family, abstract args,
+    sharding, mesh) must produce the SAME fingerprint — the cross-host
+    join key --compute relies on — and a different shape a different
+    one.  Child processes inherit the 8-device XLA_FLAGS from conftest's
+    module-scope environ write."""
+    script = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from distributed_training_comparison_tpu import obs\n"
+        "from distributed_training_comparison_tpu.parallel import make_mesh\n"
+        "from distributed_training_comparison_tpu.parallel.sharding import"
+        " put_replicated\n"
+        "mesh = make_mesh(0, 1, backend='cpu')\n"
+        "x = put_replicated(np.ones((16, 4), np.float32), mesh)\n"
+        "y = np.ones((3,), np.int32)\n"
+        "print(obs.signature_fingerprint('fam', (x, y)))\n"
+        "print(obs.signature_fingerprint('fam', (x,)))\n"
+    )
+    outs = [
+        subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, check=True,
+            cwd=str(Path(__file__).parent.parent),
+        ).stdout.split()
+        for _ in range(2)
+    ]
+    assert outs[0] == outs[1]
+    assert outs[0][0] != outs[0][1]  # different args, different executable
+
+
+def test_persistent_cache_hit_and_miss_distinguished(tmp_path, monitor_env):
+    bus, registry, monitor = monitor_env
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        x = np.ones((16, 16), np.float32)
+        fn1 = monitor.instrument(jax.jit(lambda a: a @ a), "mm")
+        fn1(x)
+        # a FRESH jit of the same program: the AOT compile must be served
+        # by the on-disk cache this time
+        fn2 = monitor.instrument(jax.jit(lambda a: a @ a), "mm")
+        fn2(x)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", old_min
+        )
+    first, second = [e["payload"]["cache"] for e in _compile_events(bus)]
+    assert first == "miss"
+    assert second == "hit"
+    snaps = registry.snapshot(reset=False)
+    assert snaps["compile/persistent_cache_misses"]["n"] == 1
+    assert snaps["compile/persistent_cache_hits"]["n"] == 1
+
+
+def test_absent_analysis_apis_degrade_to_no_data(monitor_env, monkeypatch):
+    """A jax that dropped cost_analysis/memory_analysis yields compile
+    events without flops/bytes — never an exception in the train path."""
+    bus, registry, monitor = monitor_env
+    monkeypatch.setattr(
+        compilation_mod, "executable_cost_analysis", lambda c: None
+    )
+    monkeypatch.setattr(
+        compilation_mod, "executable_memory_analysis", lambda c: None
+    )
+    fn = monitor.instrument(jax.jit(lambda x: x * 3.0), "noapi")
+    out = fn(np.ones(4, np.float32))
+    assert float(out.sum()) == 12.0
+    (ev,) = _compile_events(bus)
+    p = ev["payload"]
+    assert "flops" not in p and "peak_bytes" not in p
+    assert obs.validate_event(ev) == []
+    assert monitor.ledger()[0]["flops"] is None
+
+
+def test_broken_lowering_falls_back_to_plain_jit(monitor_env):
+    bus, registry, monitor = monitor_env
+    jitted = jax.jit(lambda x: x - 1)
+
+    class NoLower:
+        def __call__(self, *args):
+            return jitted(*args)
+
+        def lower(self, *args):  # simulate AOT API drift
+            raise AttributeError("lower moved")
+
+    fn = monitor.instrument(NoLower(), "drifted")
+    out = fn(np.ones(4, np.float32))
+    assert float(out.sum()) == 0.0
+    assert fn(np.ones(4, np.float32)) is not None  # cached fallback path
+    assert _compile_events(bus) == []  # unobserved, but unharmed
+
+
+def test_disabled_monitor_is_a_passthrough():
+    monitor = obs.CompileMonitor(enabled=False)
+    jitted = jax.jit(lambda x: x)
+    assert monitor.instrument(jitted, "x") is jitted
+    compiled, rec = monitor.aot_compile(
+        "y", lambda: jax.jit(lambda a: a).lower(np.zeros(2)).compile(),
+        parts=("p",),
+    )
+    assert rec is None and compiled is not None
+    assert monitor.take_taint() is False
+
+
+# ------------------------------------------------- recompilation sentinel
+
+
+def test_sentinel_flags_only_post_warm_compiles(monitor_env):
+    bus, registry, monitor = monitor_env
+    fn = monitor.instrument(jax.jit(lambda x: x * 2), "hot")
+    cold = monitor.instrument(
+        jax.jit(lambda x: x * 4), "evalish", sentinel=False
+    )
+    fn(np.zeros(4, np.float32))  # pre-warm: not flagged
+    monitor.warm()
+    fn(np.zeros(8, np.float32))  # post-warm sentinel family: flagged
+    cold(np.zeros(2, np.float32))  # post-warm but sentinel=False: not
+    flags = [
+        e["payload"]["recompile_after_warmup"] for e in _compile_events(bus)
+    ]
+    assert flags == [False, True, False]
+    snaps = registry.snapshot(reset=False)
+    assert snaps["compile/recompiles_after_warmup"]["n"] == 1
+
+
+def test_serve_bucket_miss_trips_sentinel_and_alert_rule(monitor_env):
+    """ISSUE 8 acceptance: a forced serve bucket miss — traffic landing
+    on a bucket the replica never warmed — drives the sentinel metric,
+    and an --alert rule on it fires, then resolves on the next clean
+    window."""
+    from distributed_training_comparison_tpu.serve import ServeEngine
+
+    bus, registry, monitor = monitor_env
+    engine = AlertEngine(
+        [AlertRule.parse("compile/recompiles_after_warmup:n>0")], bus=bus
+    )
+    bus.subscribe(engine.observe_event)
+    try:
+        serve = ServeEngine(
+            model_name="resnet18", buckets=(1, 2, 8), precision="fp32",
+            monitor=monitor,
+        )
+        serve.warmup(buckets=(1, 2))  # the replica's expected traffic
+        assert monitor.is_warm
+        registry.flush(bus)
+        assert not engine.firing  # warmup compiles are not findings
+        # the flash crowd: 5 rows → bucket 8, never compiled → sentinel
+        serve.predict_logits(np.zeros((5, 32, 32, 3), np.uint8))
+        registry.flush(bus)
+        assert engine.firing
+        registry.flush(bus)  # next window is clean: counter delta == 0
+        assert not engine.firing
+    finally:
+        bus.unsubscribe(engine.observe_event)
+    states = [
+        e["payload"]["state"] for e in bus.ring_events()
+        if e["kind"] == "alert"
+    ]
+    assert states == ["firing", "resolved"]
+    ledger = {r["fingerprint"]: r for r in monitor.ledger()}
+    assert sum(r["recompile_after_warmup"] for r in ledger.values()) == 1
+
+
+def test_warmup_rejects_bucket_outside_ladder():
+    from distributed_training_comparison_tpu.serve import ServeEngine
+
+    serve = ServeEngine(model_name="resnet18", buckets=(1, 2), precision="fp32")
+    with pytest.raises(ValueError, match="not in the ladder"):
+        serve.warmup(buckets=(4,))
+
+
+# -------------------------- satellite: compile-tainted sample exclusion
+
+
+def test_meter_routes_compile_bearing_samples_separately():
+    registry = MetricRegistry()
+    flag = {"v": False}
+
+    def taint():
+        v, flag["v"] = flag["v"], False
+        return v
+
+    meter = StepTimeMeter(metrics=registry)
+    with meter.phase("dispatch", taint=taint):
+        flag["v"] = True  # a compile happened inside this span
+    with meter.phase("dispatch", taint=taint):
+        pass
+    # stale taint raised OUTSIDE any phase must NOT poison the next one
+    flag["v"] = True
+    with meter.phase("dispatch", taint=taint):
+        pass
+    snaps = registry.snapshot(reset=False)
+    assert snaps["step/dispatch_compile_s"]["count"] == 1
+    assert snaps["step/dispatch_s"]["count"] == 2
+    # the wall clock still counts into the epoch totals either way
+    assert meter.seconds["dispatch"] >= 0
+
+
+def test_straggler_scoring_ignores_compile_tainted_sketches():
+    """A host whose only outlier samples live in the compile-tainted
+    sketch must produce NO finding — the clean series is the yardstick."""
+    def flush(proc, name, values):
+        h = MetricRegistry()
+        for v in values:
+            h.histogram(name).record(v)
+        return {
+            "v": 1, "run_id": "r", "attempt": 0, "process_index": proc,
+            "t_wall": 0.0, "t_mono": 0.0, "kind": "metrics",
+            "payload": {"metrics": h.snapshot(reset=False)},
+        }
+
+    events = []
+    for proc in (0, 1, 2):
+        events.append(flush(proc, "step/dispatch_s", [0.1] * 10))
+    # host 1's compile cliff lands ONLY in the tainted sketch
+    events.append(flush(1, "step/dispatch_compile_s", [30.0] * 10))
+    assert straggler_findings(events) == []
+
+
+# ------------------------- satellite: livelock-aware "stuck" stall state
+
+
+def test_liveness_tracker_flags_stuck_then_recovered():
+    tracker = LivenessTracker(heartbeat_s=10.0, stuck_after_beats=3)
+
+    def beat(t, step):
+        tracker.observe(
+            {"kind": "heartbeat", "process_index": 0, "attempt": 0,
+             "step": step, "epoch": 0},
+            now=t,
+        )
+
+    t = 0.0
+    for i in range(3):
+        beat(t, step=10 + i)  # advancing: healthy
+        t += 10.0
+        assert tracker.check(now=t) == []
+    for _ in range(3):  # beats keep arriving, step frozen
+        beat(t, step=13)
+        t += 10.0
+    findings = tracker.check(now=t)
+    assert [f["state"] for f in findings] == ["stuck"]
+    assert tracker.check(now=t) == []  # no flap while it persists
+    beat(t, step=14)  # progress resumes
+    findings = tracker.check(now=t + 1.0)
+    assert [f["state"] for f in findings] == ["recovered"]
+
+
+def test_stuck_yields_to_age_based_states_when_beats_stop():
+    tracker = LivenessTracker(heartbeat_s=1.0, stuck_after_beats=2)
+    for i in range(3):  # stuck at step 5, beating on schedule
+        tracker.observe(
+            {"kind": "heartbeat", "process_index": 0, "step": 5}, now=float(i)
+        )
+    assert [f["state"] for f in tracker.check(now=3.0)] == ["stuck"]
+    # then the beats stop entirely: silence escalates past livelock
+    assert [f["state"] for f in tracker.check(now=30.0)] == ["dead"]
+
+
+# ------------------------- satellite: fleet-aggregate alert rules
+
+
+def test_fleet_aggregate_rule_parses_and_requires_fleet_engine():
+    rule = AlertRule.parse("sum(train/skipped_steps):n>3")
+    assert rule.fleet_agg == "sum" and rule.metric == "train/skipped_steps"
+    assert AlertRule.parse("max(res/host_rss_bytes):value>1e9").fleet_agg == "max"
+    with pytest.raises(AlertSpecError):
+        AlertRule.parse("sum(heartbeat):age>30")
+    with pytest.raises(AlertSpecError):
+        AlertRule.parse("avg(x/y):n>1")
+
+    def flush(proc, n):
+        return {
+            "kind": "metrics", "process_index": proc,
+            "payload": {"metrics": {
+                "train/skipped_steps": {"type": "counter", "n": n}
+            }},
+        }
+
+    fleet = AlertEngine([AlertRule.parse("sum(train/skipped_steps):n>3")],
+                        fleet=True)
+    fleet.observe_event(flush(0, 2))
+    assert not fleet.firing  # one host's 2 is under the fleet threshold
+    fleet.observe_event(flush(1, 2))
+    # both hosts folded, but the aggregate is evaluated once per flush
+    # ROUND (N staggered flushes of one window must advance a for=N rule
+    # by one, not N) — the round closes when a host reports again
+    assert not fleet.firing
+    fleet.observe_event(flush(0, 2))
+    assert fleet.firing  # round closed: 2 + 2 crosses the threshold
+    assert fleet.transitions[0]["source"] == "fleet"
+
+    local = AlertEngine([AlertRule.parse("sum(train/skipped_steps):n>3")],
+                        fleet=False)
+    for _ in range(3):
+        local.observe_event(flush(0, 100))
+    assert not local.firing  # in-process engines must skip fleet rules
+
+
+def test_fleet_for_n_counts_rounds_not_process_flushes():
+    """for=3 on a fleet rule: one breaching window flushed by 8 hosts
+    must count as ONE window, not fire instantly."""
+    rule = AlertRule.parse("sum(train/skipped_steps):n>0:for=3")
+    engine = AlertEngine([rule], fleet=True)
+
+    def flush(proc, n):
+        return {
+            "kind": "metrics", "process_index": proc,
+            "payload": {"metrics": {
+                "train/skipped_steps": {"type": "counter", "n": n}
+            }},
+        }
+
+    for rnd in range(3):
+        assert not engine.firing, f"fired after only {rnd} round(s)"
+        for proc in range(8):
+            engine.observe_event(flush(proc, 1))
+    engine.observe_event(flush(0, 1))  # closes the third breaching round
+    assert engine.firing
+
+
+def test_fleet_max_aggregate_drops_dead_hosts_and_resolves():
+    rule = AlertRule.parse("max(res/open_fds):value>100:for=1")
+    engine = AlertEngine([rule], fleet=True)
+
+    def flush(proc, v):
+        return {
+            "kind": "metrics", "process_index": proc,
+            "payload": {"metrics": {"res/open_fds": {"type": "gauge", "value": v}}},
+        }
+
+    engine.observe_event(flush(0, 50))
+    engine.observe_event(flush(1, 150))
+    engine.observe_event(flush(0, 50))  # round closes: max(50, 150)
+    assert engine.firing
+    # host 1 dies (never reports again): its stale 150 must fall out of
+    # the fold at the next round, so the rule can resolve
+    engine.observe_event(flush(0, 50))
+    assert not engine.firing
+    # attempt reset forgets the fold entirely, hysteresis state survives
+    engine.reset_fleet()
+    assert engine._fleet_state == {}
+
+
+# ------------------------- satellite: live-array census
+
+
+def test_live_array_census_counts_and_skips_deleted():
+    keep = jnp.ones((128,), jnp.float32)
+    census = live_array_census()
+    assert census is not None
+    count, total = census
+    assert count >= 1 and total >= keep.nbytes
+    dead = jnp.ones((64,), jnp.float32)
+    dead.delete()
+    count2, total2 = live_array_census()  # deleted arrays never raise out
+    assert count2 >= 1
+    registry = MetricRegistry()
+    sampler = ResourceSampler(min_interval_s=0.0)
+    values = sampler.sample(registry)
+    assert values.get("res/live_arrays", 0) >= 1
+    assert values.get("res/live_array_bytes", 0) > 0
+
+
+# ----------------------------------------- run_report --compute offline
+
+
+def _compile_event(name, fp, **payload):
+    base = {
+        "v": 1, "run_id": "r", "attempt": 0, "process_index": 0,
+        "t_wall": 1.0, "t_mono": 1.0, "kind": "compile",
+        "payload": {
+            "name": name, "fingerprint": fp, "compile_s": 0.5,
+            "cache": "miss", "compiles_of_fingerprint": 1,
+            "recompile_after_warmup": False, "platform": "tpu",
+            "device_kind": "TPU v4", "devices": 4, "flops": 1e12,
+            "peak_bytes": 2 << 30, **payload,
+        },
+    }
+    return base
+
+
+def _exec_flush(name, fp, count, total_s):
+    reg = MetricRegistry()
+    h = reg.histogram(f"exec/{name}:{fp[:8]}/dispatch_s")
+    for _ in range(count):
+        h.record(total_s / count)
+    return {
+        "v": 1, "run_id": "r", "attempt": 0, "process_index": 0,
+        "t_wall": 2.0, "t_mono": 2.0, "kind": "metrics",
+        "payload": {"metrics": reg.snapshot(reset=False)},
+    }
+
+
+def test_compute_summary_measured_mfu_from_events_alone():
+    fp = "aabbccddeeff0011"
+    events = [
+        _compile_event("chunk_runner", fp),
+        _exec_flush("chunk_runner", fp, count=10, total_s=10.0),
+    ]
+    comp = run_report.compute_summary(events)
+    (row,) = comp["rows"]
+    assert row["compiles"] == 1 and row["cache_misses"] == 1
+    assert row["dispatches"] == 10
+    assert abs(row["dispatch_s"] - 10.0) < 0.2  # sketch-quantized sum
+    # 1e12 flops x 10 dispatches / 10 s / (275e12 x 4 chips) ≈ 0.0909%
+    assert row["mfu"] == pytest.approx(
+        1e12 * 10 / row["dispatch_s"] / (275e12 * 4), rel=1e-6
+    )
+    text = run_report.format_compute(comp)
+    assert "chunk_runner" in text and "aabbccdd" in text
+    assert "measured MFU" in text
+    # --peak-flops overrides the device-kind table
+    comp2 = run_report.compute_summary(events, peak_override=1e12)
+    assert comp2["rows"][0]["mfu"] == pytest.approx(
+        1e12 * 10 / comp2["rows"][0]["dispatch_s"] / (1e12 * 4), rel=1e-6
+    )
+
+
+def test_compute_summary_marks_sentinel_findings_and_unknown_peak():
+    events = [
+        _compile_event(
+            "serve_predict", "0123456789abcdef",
+            recompile_after_warmup=True, device_kind="cpu", platform="cpu",
+        ),
+    ]
+    comp = run_report.compute_summary(events)
+    assert comp["totals"]["recompiles_after_warmup"] == 1
+    assert comp["rows"][0]["mfu"] is None  # no peak entry for cpu
+    text = run_report.format_compute(comp)
+    assert "AFTER warmup" in text
+
+
+def test_check_require_kind(tmp_path):
+    path = tmp_path / "events.jsonl"
+    ev = {
+        "v": 1, "run_id": "r", "attempt": 0, "process_index": 0,
+        "t_wall": 1.0, "t_mono": 1.0, "kind": "run_start",
+    }
+    path.write_text(json.dumps(ev) + "\n")
+    assert run_report.check_run(tmp_path) == []
+    problems = run_report.check_run(tmp_path, require_kinds=("compile",))
+    assert problems and "compile" in problems[0]
+    assert run_report.main([str(tmp_path), "--check"]) == 0
+    assert run_report.main(
+        [str(tmp_path), "--check", "--require-kind", "compile"]
+    ) == 1
+
+
+# ------------------------------------------------------- trainer + e2e
+
+
+def _tiny_trainer(tmp_path, extra=()):
+    from test_train import TinyNet  # noqa: E402
+
+    from distributed_training_comparison_tpu.train import Trainer
+
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "640",
+            "--batch-size", "32", "--epoch", "2", "--no-progress",
+            "--eval-step", "10000", "--seed", "7",
+            "--save-last-min-secs", "0", "--device-chunk-steps", "6",
+            "--metrics-flush-steps", "8", "--ckpt-path", str(tmp_path),
+            *extra,
+        ],
+    )
+    return Trainer(hp, model=TinyNet(num_classes=100))
+
+
+def test_trainer_emits_compile_events_and_compute_table(tmp_path, capsys):
+    """A real (in-process) training run produces `compile` events for
+    every distinct executable, and run_report --compute renders the
+    per-executable table — dispatch counts, cache column, flops, peak
+    HBM, measured MFU (forced via --peak-flops on this CPU host) — from
+    the event stream alone."""
+    trainer = _tiny_trainer(tmp_path)
+    try:
+        trainer.fit()
+        trainer.test()
+    finally:
+        trainer.close()
+    events, _files = run_report.load_run(tmp_path)
+    comp_events = [e for e in events if e.get("kind") == "compile"]
+    names = {e["payload"]["name"] for e in comp_events}
+    assert any(n.startswith("device_chunk_runner") for n in names)
+    assert "eval_runner" in names
+    for ev in comp_events:
+        assert obs.validate_event(ev) == []
+    comp = run_report.compute_summary(events, peak_override=1e12)
+    by_name = {r["name"]: r for r in comp["rows"]}
+    chunk = next(
+        r for n, r in by_name.items() if n.startswith("device_chunk_runner")
+    )
+    assert chunk["compiles"] == 1
+    assert chunk["cache"] in ("hit", "miss")
+    assert chunk["dispatches"] >= 2  # 2 epochs x >=1 full chunk each
+    assert chunk["flops"] > 0 and chunk["peak_bytes"] > 0
+    assert chunk["mfu"] is not None and chunk["mfu"] > 0
+    # no sentinel findings in an undisturbed run: steady state is steady
+    assert comp["totals"]["recompiles_after_warmup"] == 0
+    # the CLI path renders the same table
+    rc = run_report.main([str(tmp_path), "--compute", "--peak-flops", "1e12"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device_chunk_runner" in out and "measured MFU" in out
+    # and the capture passes the kind-required self check
+    assert run_report.main(
+        [str(tmp_path), "--check", "--require-kind", "compile"]
+    ) == 0
+
+
+def test_no_obs_run_emits_no_compile_events(tmp_path):
+    trainer = _tiny_trainer(tmp_path, extra=("--no-obs", "--no-flight-ring"))
+    try:
+        trainer.fit()
+    finally:
+        trainer.close()
+    assert not list(Path(tmp_path).glob("version-*/events*.jsonl"))
+    assert trainer.compile_monitor.ledger() == []
+
+
+@pytest.mark.slow
+def test_e2e_supervised_run_compile_ledger(tmp_path):
+    """ISSUE 8 acceptance (supervised leg): a supervised CPU run through
+    a preemption produces `compile` events in EVERY attempt, the
+    --compute table reconstructs per-executable rows with measured MFU
+    from the merged stream, --diff carries the compiler rows, and no
+    false sentinel finding appears (each attempt re-warms its own
+    monitor)."""
+    from distributed_training_comparison_tpu.resilience import Supervisor
+
+    worker = Path(__file__).parent / "resil_worker.py"
+    run_id = obs.new_run_id()
+
+    def env_for(attempt):
+        import os
+
+        env = dict(os.environ)
+        env[obs.RUN_ID_ENV] = run_id
+        env[obs.ATTEMPT_ENV] = str(attempt)
+        return env
+
+    cmd = [
+        sys.executable, str(worker),
+        "--synthetic-data", "--limit-examples", "256",
+        "--batch-size", "32", "--epoch", "3", "--no-progress",
+        "--eval-step", "10000", "--save-last-min-secs", "0",
+        "--device-chunk-steps", "4", "--metrics-flush-steps", "4",
+        "--resilience", "--auto-resume",
+        "--fault-plan", "preempt@epoch=1",
+        "--ckpt-path", str(tmp_path),
+    ]
+    summary = Supervisor(cmd, env=env_for, max_restarts=3).run()
+    assert summary["final_rc"] == 0 and summary["preemptions"] == 1
+
+    events, _files = run_report.load_run(tmp_path)
+    by_attempt = {}
+    for ev in events:
+        if ev.get("kind") == "compile":
+            by_attempt.setdefault(int(ev.get("attempt", 0)), []).append(ev)
+    assert set(by_attempt) == {0, 1}  # both attempts observed compiles
+    assert all(
+        not e["payload"]["recompile_after_warmup"]
+        for evs in by_attempt.values() for e in evs
+    )
+    comp = run_report.compute_summary(events, peak_override=1e12)
+    assert comp["totals"]["compiles"] >= 2
+    chunk_rows = [
+        r for r in comp["rows"] if r["name"].startswith("device_chunk_runner")
+    ]
+    assert chunk_rows and any(r["mfu"] for r in chunk_rows)
+    # the self check the bench resilience leg now runs
+    assert run_report.check_run(tmp_path, require_kinds=("compile",)) == []
+    # --diff over the same run: the compiler rows render with zero delta
+    diff = run_report.format_diff(
+        "a", run_report.summarize(events), "b", run_report.summarize(events)
+    )
+    assert "compiles" in diff and "mfu %" in diff

@@ -207,8 +207,17 @@ def test_registry_gauges_survive_flush_counters_reset():
     assert ev["payload"]["metrics"]["g"]["value"] == 3.0
     reg.gauge("g").set(5.0)
     ev2 = reg.flush(bus)
-    assert "c" not in ev2["payload"]["metrics"]  # counter reset to empty
+    # the delta reset; the counter keeps reporting EXPLICIT zero windows
+    # once it has ever fired (PR 8: counter alert rules — skipped steps,
+    # the recompilation sentinel — resolve on observed clean windows,
+    # never on absences)
+    assert ev2["payload"]["metrics"]["c"]["n"] == 0
     assert ev2["payload"]["metrics"]["g"]["value"] == 5.0
+    reg2 = MetricRegistry(flush_steps=1)
+    reg2.counter("never")  # registered but never fired: stays dead weight
+    reg2.gauge("g2").set(1.0)
+    ev3 = reg2.flush(bus)
+    assert "never" not in ev3["payload"]["metrics"]
 
 
 def test_registry_name_type_conflict_raises():
@@ -826,8 +835,14 @@ def test_e2e_metrics_events_and_flight_ring(tmp_path):
         summ = histogram_summary(merged[name])
         assert summ is not None and summ["count"] == trained, (name, summ)
         assert summ["p50"] <= summ["p95"] <= summ["p99"] <= summ["max"]
-    # the step-phase sketches ride the same stream (one sample per chunk)
-    assert merged["step/dispatch_s"]["count"] >= 3
+    # the step-phase sketches ride the same stream (one sample per chunk).
+    # The FIRST dispatch carried the epoch runner's jit compile, so the
+    # compile monitor's taint reroutes it to step/dispatch_compile_s —
+    # the straggler-scored clean sketch sees only compile-free samples
+    # (PR 8: a warm-resumed host must not read as fast)
+    clean = merged["step/dispatch_s"]["count"]
+    tainted = merged.get("step/dispatch_compile_s", {}).get("count", 0)
+    assert clean + tainted >= 3 and tainted >= 1, (clean, tainted)
     assert merged["step/compute_s"]["count"] >= 3
     # the checkpoint writer's gauge flushed at least once
     assert "ckpt/queue_depth" in merged
@@ -890,6 +905,12 @@ def test_bench_obs_overhead_within_budget(tmp_path, monkeypatch):
     assert record["within_budget"], record
     assert record["events_check_rc"] == 0
     assert record["flushes"] > 0
+    # the compile-capture leg (PR 8): the instrumented dispatch path's
+    # per-step price holds the same budget, and its observed compile is
+    # on the stream (events_check_rc above REQUIRES a compile event)
+    leg = record["compile_capture"]
+    assert leg["within_budget"], leg
+    assert leg["observed_compiles"] >= 1
 
 
 # ------------------------------------------------------------ config flags

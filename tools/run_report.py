@@ -10,6 +10,8 @@ Usage::
     python tools/run_report.py CKPT_ROOT --blackbox   # decode flight rings
     python tools/run_report.py CKPT_ROOT --alerts     # alert timeline; rc=1
                                                       # while any rule fires
+    python tools/run_report.py CKPT_ROOT --compute    # per-executable
+                                                      # cost/memory/MFU table
     python tools/run_report.py CKPT_ROOT --export-openmetrics [OUT]
                                                       # offline scrape render
     python tools/run_report.py CKPT_ROOT --xplane OUT.json \\
@@ -75,6 +77,7 @@ from distributed_training_comparison_tpu.obs import (  # noqa: E402
     histogram_summary,
     load_events,
     merge_metric_events,
+    peak_flops_for,
     render_openmetrics,
     straggler,
     validate_event,
@@ -231,15 +234,24 @@ def apply_clock_skew(events: list[dict], offsets: dict) -> list[dict]:
     return out
 
 
-def check_run(path: str | Path, counts: list | None = None) -> list[str]:
+def check_run(
+    path: str | Path,
+    counts: list | None = None,
+    require_kinds=(),
+) -> list[str]:
     """Schema violations across every event file under ``path`` (one read
     per file).  ``counts``, when given, receives the per-file parsed-event
-    counts so the caller can report totals without re-reading."""
+    counts so the caller can report totals without re-reading.
+    ``require_kinds`` names event kinds the merged stream MUST contain —
+    the bench legs assert their captures carry ``compile`` events, so a
+    silently-degraded compile hook fails the capture's self-validation
+    instead of committing a record with the ledger missing."""
     problems: list[str] = []
     files = find_event_files(path)
     if not files:
         problems.append(f"{path}: no events*.jsonl found")
         return problems
+    seen_kinds: set = set()
     for f in files:
         parsed: list[dict] = []
         torn = 0
@@ -254,10 +266,18 @@ def check_run(path: str | Path, counts: list | None = None) -> list[str]:
         if torn:
             problems.append(f"{f}: {torn} unparseable line(s)")
         for i, ev in enumerate(parsed):
+            if isinstance(ev, dict) and ev.get("kind"):
+                seen_kinds.add(ev["kind"])
             for err in validate_event(ev):
                 problems.append(f"{f}:{i + 1}: {err}")
         if counts is not None:
             counts.append(len(parsed))
+    for kind in require_kinds or ():
+        if kind not in seen_kinds:
+            problems.append(
+                f"{path}: no {kind!r} events in the stream "
+                "(--require-kind)"
+            )
     return problems
 
 
@@ -365,6 +385,9 @@ def summarize(events: list[dict]) -> dict:
         # cross-host view the per-attempt fold above deliberately dedups
         # away
         "straggler_lines": straggler.format_table(events),
+        # the per-executable compile/cost/memory fold (PR 8) — --compute
+        # renders it; --diff compares its totals across runs
+        "compute": compute_summary(events),
         "events": len(events),
         "rollbacks": sum(a["rollbacks"] for a in attempts.values()),
         "epochs": sum(a["epochs"] for a in attempts.values()),
@@ -799,10 +822,192 @@ def export_openmetrics(path: str | Path, out_path: str | None = None) -> str:
     return text
 
 
+# ----------------------------------------------------------------- compute
+#
+# The per-executable table: everything below reconstructs from the event
+# stream alone — `compile` events carry identity (fingerprint), compile
+# accounting, and the HLO cost/memory analysis; the per-executable
+# `exec/{name}:{fp8}/dispatch_s` sketches inside the `metrics` flushes
+# carry dispatch counts and dispatch-span seconds.  Measured MFU =
+# analysis flops × dispatches ÷ dispatch-span seconds ÷ (peak chip
+# FLOP/s × devices), with the peak keyed off the device kind the compile
+# event recorded (override with --peak-flops; CPU captures have no peak
+# table entry, so MFU prints '-' there — dispatch spans on CPU measure
+# host time anyway, see the README caveat).
+
+
+def compute_summary(events: list[dict], peak_override: float | None = None) -> dict:
+    """Fold a merged stream's ``compile`` events + exec dispatch sketches
+    into per-executable rows (process-0 events only, like every other
+    per-attempt fold: all processes compile the same executables)."""
+    rows: dict[str, dict] = {}
+    metric_events = []
+    for ev in events:
+        if int(ev.get("process_index", 0)) != 0:
+            continue
+        kind = ev.get("kind")
+        if kind == "metrics":
+            metric_events.append(ev)
+            continue
+        if kind != "compile":
+            continue
+        p = _payload(ev)
+        fp = str(p.get("fingerprint", "?"))
+        row = rows.setdefault(
+            fp,
+            {
+                "name": p.get("name", "?"),
+                "fingerprint": fp,
+                "compiles": 0,
+                "cache_hits": 0,
+                "cache_misses": 0,
+                "cache": p.get("cache", "unknown"),
+                "compile_s": 0.0,
+                "flops": None,
+                "peak_bytes": None,
+                "recompile_after_warmup": False,
+                "device_kind": p.get("device_kind"),
+                "devices": p.get("devices"),
+            },
+        )
+        row["compiles"] += 1
+        row["compile_s"] += float(p.get("compile_s", 0.0))
+        if p.get("cache") == "hit":
+            row["cache_hits"] += 1
+        elif p.get("cache") == "miss":
+            row["cache_misses"] += 1
+        row["cache"] = p.get("cache", row["cache"])
+        if p.get("flops") is not None:
+            row["flops"] = float(p["flops"])
+        if p.get("peak_bytes") is not None:
+            row["peak_bytes"] = int(p["peak_bytes"])
+        row["recompile_after_warmup"] = (
+            row["recompile_after_warmup"] or bool(p.get("recompile_after_warmup"))
+        )
+    merged = merge_metric_events(metric_events)
+    totals = {
+        "executables": len(rows), "compiles": 0, "compile_s": 0.0,
+        "cache_hits": 0, "cache_misses": 0, "recompiles_after_warmup": 0,
+        "flops_dispatched": 0.0, "dispatch_s": 0.0,
+    }
+    mfu_num = mfu_den = 0.0
+    for row in rows.values():
+        sketch = merged.get(f"exec/{row['name']}:{row['fingerprint'][:8]}/dispatch_s")
+        row["dispatches"] = int((sketch or {}).get("count", 0))
+        row["dispatch_s"] = float((sketch or {}).get("sum", 0.0))
+        peak = (
+            peak_override
+            if peak_override
+            else peak_flops_for(row["device_kind"])
+        )
+        row["mfu"] = None
+        if (
+            peak
+            and row["flops"]
+            and row["dispatches"]
+            and row["dispatch_s"] > 0
+        ):
+            devices = row["devices"] or 1
+            row["mfu"] = (
+                row["flops"] * row["dispatches"]
+                / row["dispatch_s"] / (peak * devices)
+            )
+            mfu_num += row["flops"] * row["dispatches"]
+            mfu_den += row["dispatch_s"] * peak * devices
+        totals["compiles"] += row["compiles"]
+        totals["compile_s"] += row["compile_s"]
+        totals["cache_hits"] += row["cache_hits"]
+        totals["cache_misses"] += row["cache_misses"]
+        totals["recompiles_after_warmup"] += int(row["recompile_after_warmup"])
+        if row["flops"] and row["dispatches"]:
+            totals["flops_dispatched"] += row["flops"] * row["dispatches"]
+        totals["dispatch_s"] += row["dispatch_s"]
+    # run-level MFU: flops-weighted over every executable with a peak —
+    # the one number --diff compares across runs
+    totals["mfu"] = (mfu_num / mfu_den) if mfu_den > 0 else None
+    # the array side of the HBM ledger, if the stream carried the census
+    census = merged.get("res/live_array_bytes")
+    if census is not None:
+        totals["live_array_bytes"] = census.get("value")
+    return {
+        "rows": sorted(
+            rows.values(), key=lambda r: (r["name"], r["fingerprint"])
+        ),
+        "totals": totals,
+    }
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TB"
+
+
+def format_compute(comp: dict) -> str:
+    """The ``--compute`` view: the per-executable cost/memory table."""
+    rows = comp["rows"]
+    if not rows:
+        return (
+            "(no compile events in the stream — a pre-PR-8 capture, or a "
+            "--no-obs run)"
+        )
+    header = (
+        f"{'executable':<28} {'fingerprnt':>10} {'compiles':>8} "
+        f"{'cache':>7} {'compile_s':>9} {'flops':>10} {'peak_hbm':>9} "
+        f"{'dispatches':>10} {'dispatch_s':>10} {'mfu':>7}"
+    )
+    lines = ["per-executable compute/memory ledger:", header, "-" * len(header)]
+    for r in rows:
+        name = r["name"][:28]
+        flops = f"{r['flops']:.3g}" if r["flops"] is not None else "-"
+        mfu = f"{100 * r['mfu']:6.2f}%" if r["mfu"] is not None else f"{'-':>7}"
+        mark = " *" if r["recompile_after_warmup"] else ""
+        lines.append(
+            f"{name:<28} {r['fingerprint'][:8]:>10} {r['compiles']:>8} "
+            f"{r['cache']:>7} {r['compile_s']:>9.3f} {flops:>10} "
+            f"{_fmt_bytes(r['peak_bytes']):>9} {r['dispatches']:>10} "
+            f"{r['dispatch_s']:>10.4f} {mfu}{mark}"
+        )
+    t = comp["totals"]
+    lines.append(
+        f"  totals: {t['executables']} executable(s), {t['compiles']} "
+        f"compile(s) ({t['compile_s']:.2f}s), persistent cache "
+        f"{t['cache_hits']} hit(s) / {t['cache_misses']} miss(es)"
+    )
+    if t["recompiles_after_warmup"]:
+        lines.append(
+            f"  * {t['recompiles_after_warmup']} executable(s) compiled "
+            "AFTER warmup — the recompilation sentinel's findings "
+            "(serve bucket churn / unexpected reshape)"
+        )
+    if t.get("mfu") is not None:
+        lines.append(
+            f"  measured MFU (flops-weighted across executables): "
+            f"{100 * t['mfu']:.2f}%"
+        )
+    elif t["dispatch_s"] > 0:
+        lines.append(
+            "  measured MFU: no peak-FLOPs entry for this device kind "
+            "(CPU capture?) — pass --peak-flops to force a denominator"
+        )
+    if t.get("live_array_bytes") is not None:
+        lines.append(
+            f"  live-array census (res/live_array_bytes, last sample): "
+            f"{_fmt_bytes(t['live_array_bytes'])}"
+        )
+    return "\n".join(lines)
+
+
 # -------------------------------------------------------------------- diff
 
 
 def format_diff(name_a: str, a: dict, name_b: str, b: dict) -> str:
+    ca, cb = a.get("compute", {}).get("totals", {}), b.get("compute", {}).get("totals", {})
     rows = [
         ("attempts", len(a["attempts"]), len(b["attempts"])),
         ("epochs", a["epochs"], b["epochs"]),
@@ -811,20 +1016,38 @@ def format_diff(name_a: str, a: dict, name_b: str, b: dict) -> str:
         ("goodput %", 100 * a["goodput_frac"], 100 * b["goodput_frac"]),
         ("productive s", a["productive_s"], b["productive_s"]),
         ("h2d wait s", a["h2d_wait_s"], b["h2d_wait_s"]),
+        # the compiler plane (PR 8): did the second run compile more,
+        # spend longer in the compiler, trip the recompilation sentinel,
+        # or lose measured MFU
+        ("compiles", ca.get("compiles", 0), cb.get("compiles", 0)),
+        ("compile s", ca.get("compile_s", 0.0), cb.get("compile_s", 0.0)),
+        (
+            "recompiles",
+            ca.get("recompiles_after_warmup", 0),
+            cb.get("recompiles_after_warmup", 0),
+        ),
+        (
+            # None (no peak-FLOPs entry — CPU captures) renders '-', NOT
+            # 0.0: a fabricated zero would read as a measured regression
+            "mfu %",
+            100 * ca["mfu"] if ca.get("mfu") is not None else None,
+            100 * cb["mfu"] if cb.get("mfu") is not None else None,
+        ),
     ]
     w = max(len(name_a), len(name_b), 12)
     lines = [
         f"{'':<14} {name_a[:w]:>{w}} {name_b[:w]:>{w}} {'Δ':>10}",
     ]
     for label, va, vb in rows:
-        delta = vb - va
+        delta = None if va is None or vb is None else vb - va
         fmt = (
             (lambda v: f"{v:.1f}")
             if isinstance(va, float) or isinstance(vb, float)
             else str
         )
+        cell = lambda v: "-" if v is None else fmt(v)  # noqa: E731
         lines.append(
-            f"{label:<14} {fmt(va):>{w}} {fmt(vb):>{w}} {fmt(delta):>10}"
+            f"{label:<14} {cell(va):>{w}} {cell(vb):>{w}} {cell(delta):>10}"
         )
     return "\n".join(lines)
 
@@ -840,6 +1063,25 @@ def main(argv: list[str]) -> int:
     ap.add_argument(
         "--check", action="store_true",
         help="validate every event against the schema; exit 1 on violations",
+    )
+    ap.add_argument(
+        "--require-kind", action="append", default=None, metavar="KIND",
+        help="with --check: additionally fail unless the merged stream "
+        "contains at least one event of KIND (repeatable; the bench legs "
+        "require 'compile' so a degraded compile hook can't pass)",
+    )
+    ap.add_argument(
+        "--compute", action="store_true",
+        help="print the per-executable compute/memory ledger reconstructed "
+        "from the compile events + exec dispatch sketches: compiles, "
+        "persistent-cache outcome, compile time, analysis flops, peak "
+        "HBM, dispatches, dispatch-span seconds, measured MFU",
+    )
+    ap.add_argument(
+        "--peak-flops", type=float, default=None, metavar="FLOPS",
+        help="per-chip peak FLOP/s override for the --compute MFU column "
+        "(default: keyed off the device kind recorded in the compile "
+        "events; unknown kinds — e.g. CPU — render '-')",
     )
     ap.add_argument(
         "--diff", action="store_true",
@@ -937,13 +1179,25 @@ def main(argv: list[str]) -> int:
         rc = 0
         for path in args.paths:
             counts: list = []
-            problems = check_run(path, counts)
+            problems = check_run(path, counts, require_kinds=args.require_kind)
             if problems:
                 rc = 1
                 for p in problems:
                     print(f"SCHEMA VIOLATION {p}", file=sys.stderr)
             else:
                 print(f"{path}: {sum(counts)} events OK")
+        return rc
+
+    if args.compute:
+        rc = 0
+        for path in args.paths:
+            events, _files = load_run(path)
+            if not events:
+                print(f"{path}: no events found", file=sys.stderr)
+                rc = 2
+                continue
+            print(f"{path}:")
+            print(format_compute(compute_summary(events, args.peak_flops)))
         return rc
 
     if args.diff:
