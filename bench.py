@@ -748,97 +748,164 @@ def events_check_rc(ckpt_root: str, require_kinds=()) -> int:
 
 
 def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
-    """The resilience leg: a real supervised training run through the fault
-    gauntlet — injected preemption at epoch 1, supervisor relaunch with
-    ``--auto-resume`` (on CPU: onto a DIFFERENT forced device count — the
-    elastic path), goodput aggregated across the attempts into
-    ``GOODPUT.json`` (pretty-print with ``tools/goodput_report.py``).
+    """The resilience leg: the ELASTIC-POOL gauntlet (ISSUE 10) — a real
+    supervised 2-host fleet run through ``--supervise --fleet-hosts 2``
+    that loses host 1 to a SIGKILL mid-run (shrink: the re-rendered
+    world-size-1 attempt resumes from the verified checkpoint), re-admits
+    it via the ``fleet/host-1.up`` marker (a deliberate
+    drain-checkpoint-and-re-expand), and finishes at full width.  The
+    supervisor's GOODPUT.json — goodput across every attempt plus the
+    priced ``resize`` list — is the committed scoreboard; the capture
+    self-validates with ``run_report --check --require-kind compile
+    --require-kind resize``.
 
-    Children are separate processes (the per-attempt device-count flag must
-    land before jax initializes), launched through the real
-    ``src/tpu_jax/main.py`` entry so the measured recovery cost includes
-    everything a production relaunch pays: process start, imports, compile
-    (persistent cache), restore.
+    Children are separate processes launched by the FleetSupervisor with
+    re-rendered ``--world-size``/``--rank``/``--dist-url``, so the
+    measured recovery cost includes everything a production relaunch pays:
+    process start, imports, compile (persistent cache), restore.  Note
+    the CPU emulation keeps rank 0's own device count constant across
+    attempts, so DEVICE-count-changing reshard is not what this leg
+    measures — that path is pinned end-to-end by tier-1's
+    ``test_e2e_preempt_supervisor_elastic`` (8→4 devices, params
+    allclose).  On CPU
+    the child is ``tests/fleet_pool_worker.py`` (rank 0 trains for real;
+    rank 1 is a pid+event-file host emulation — the pinned CI jax cannot
+    run multi-process collectives on the CPU backend, see
+    tests/test_multihost.py); on a TPU fleet the real
+    ``src/tpu_jax/main.py`` entry serves, its ranks genuinely
+    rendezvousing via ``init_distributed``.
     """
     import os
+    import signal as _signal
     import subprocess
     import sys
     import tempfile
-
-    from distributed_training_comparison_tpu.resilience import Supervisor
-    from distributed_training_comparison_tpu.resilience.goodput import (
-        aggregate_goodput,
-        collect_goodput_records,
-        write_goodput,
-    )
+    import threading
+    import time as _time
 
     platform = jax.devices()[0].platform
     repo = os.path.dirname(os.path.abspath(__file__))
     ckpt_root = tempfile.mkdtemp(prefix="resilience-bench-")
-    if platform == "cpu":  # CI smoke sizing (this container: ONE cpu core —
-        # small forced meshes keep the per-child XLA compile tractable)
-        size_args = ["--limit-examples", "128", "--batch-size", "64", "--epoch", "3"]
-        device_counts = {0: 2, 1: 1}  # preempted on 2 devices, resumed on 1 (elastic)
+    if platform == "cpu":  # CI sizing (this container: ONE cpu core —
+        # tiny forced meshes keep the per-child XLA compile tractable).
+        # Epoch count is chosen so productive step time dominates the three
+        # attempts' init/restore overhead: the scoreboard must price the
+        # shrink/expand against a run long enough to be worth resuming.
+        child = os.path.join(repo, "tests", "fleet_pool_worker.py")
+        size_args = [
+            "--limit-examples", "4096", "--batch-size", "32", "--epoch", "150",
+        ]
     else:
-        size_args = ["--limit-examples", "4096", "--batch-size", "256", "--epoch", "6"]
-        device_counts = {}
+        child = os.path.join(repo, "src", "tpu_jax", "main.py")
+        size_args = [
+            "--limit-examples", "4096", "--batch-size", "256", "--epoch", "150",
+        ]
 
     cmd = [
-        sys.executable, os.path.join(repo, "src", "tpu_jax", "main.py"),
+        sys.executable, child, "--supervise",
+        "--fleet-hosts", "2", "--fleet-local-devices",
+        "1" if platform == "cpu" else "0",
+        "--fleet-grace-secs", "3", "--fleet-poll-secs", "0.2",
         "--synthetic-data", *size_args,
         "--ckpt-path", ckpt_root,
         "--save-last-min-secs", "0", "--no-progress",
-        "--resilience", "--auto-resume",
-        "--fault-plan", "preempt@epoch=1",
+        "--seed", "7", "--eval-step", "1000",
+        "--device-chunk-steps", "8",
+        "--heartbeat-secs", "0.5",
+        "--goodput-json", out_path,
     ]
 
-    from distributed_training_comparison_tpu import obs
+    status_path = os.path.join(ckpt_root, "fleet", "status.json")
+    events_path = os.path.join(ckpt_root, "version-0", "events.jsonl")
+    driver_log: list = []
 
-    run_id = obs.new_run_id()  # one identity across the gauntlet's attempts
+    def _wait(cond, what, proc, timeout=600.0) -> bool:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if proc.poll() is not None:
+                driver_log.append(f"fleet exited before {what}")
+                return False
+            try:
+                if cond():
+                    return True
+            except (OSError, ValueError, KeyError):
+                pass
+            _time.sleep(0.05)
+        driver_log.append(f"timed out waiting for {what}")
+        return False
 
-    def env_for(attempt: int) -> dict:
-        if not device_counts:
-            env = dict(os.environ)
-        else:
-            from distributed_training_comparison_tpu.resilience.elastic import (
-                forced_host_device_env,
-            )
+    def drive(proc) -> None:
+        """The gauntlet's fault script: kill host 1 once attempt 0 has a
+        verified checkpoint; re-admit it once the shrunk attempt is up."""
+        from distributed_training_comparison_tpu.resilience import read_manifest
 
-            env = forced_host_device_env(
-                device_counts.get(attempt, device_counts[max(device_counts)])
-            )
-        env[obs.RUN_ID_ENV] = run_id
-        env[obs.ATTEMPT_ENV] = str(attempt)
-        return env
+        def status():
+            with open(status_path) as f:
+                return json.load(f)
 
-    def runner(c, env):
-        proc = subprocess.run(list(c), env=env, capture_output=True, text=True)
-        emit_progress(
-            "resilience_attempt",
-            {"rc": proc.returncode, "tail": (proc.stdout or "")[-300:]},
+        if not _wait(
+            lambda: status()["attempt"] == 0
+            and read_manifest(
+                os.path.join(ckpt_root, "version-0", "last.ckpt")
+            ) is not None,
+            "attempt 0 checkpoint", proc,
+        ):
+            return
+        os.kill(int(status()["pids"]["1"]), _signal.SIGKILL)
+        driver_log.append("killed host 1")
+        if not _wait(
+            lambda: status()["attempt"] == 1
+            and any(
+                '"kind": "run_start"' in line and '"attempt": 1' in line
+                for line in open(events_path).read().splitlines()
+            ),
+            "attempt 1 run_start", proc,
+        ):
+            return
+        with open(os.path.join(ckpt_root, "fleet", "host-1.up"), "w"):
+            pass
+        driver_log.append("re-admitted host 1")
+
+    proc = subprocess.Popen(
+        cmd, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    driver = threading.Thread(target=drive, args=(proc,), daemon=True)
+    driver.start()
+    out, err = proc.communicate()
+    driver.join(timeout=10.0)
+    emit_progress(
+        "resilience_fleet",
+        {"rc": proc.returncode, "driver": driver_log,
+         "tail": (out or "")[-300:]},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"elastic-pool gauntlet failed (rc={proc.returncode}; driver: "
+            f"{driver_log}): {(err or '')[-2000:]}"
         )
-        return proc.returncode
 
-    summary = Supervisor(
-        cmd, env=env_for, max_restarts=3, backoff_base=0.2, runner=runner
-    ).run()
-    # every version dir, not a hardcoded version-0: an attempt that died
-    # before its first save leaves its goodput record in one dir while the
-    # relaunch progresses in the next — both belong in the aggregate
-    records = collect_goodput_records(ckpt_root)
-    record = aggregate_goodput(
-        records,
-        downtime_s=summary["downtime_s"],
-        restarts=summary["restarts"],
-        preemptions=summary["preemptions"],
-    )
-    record["supervisor"] = summary
+    # run_supervised wrote the aggregate (incl. the resize list) to
+    # out_path; fold in the capture provenance + self-validation
+    with open(out_path) as f:
+        record = json.load(f)
     record["platform"] = platform
-    # compile events required: every attempt's trainer must have emitted
-    # its per-executable ledger (PR 8) or the capture fails itself
+    record["gauntlet"] = {
+        "fleet_hosts": 2,
+        "script": "SIGKILL host 1 -> shrink to world 1 -> host-1.up -> "
+                  "re-expand to world 2",
+        "driver": driver_log,
+    }
+    # compile events required (PR 8: every attempt's executable ledger)
+    # AND resize events (ISSUE 10: the shrink/expand must be priced) — a
+    # silently-degraded hook can't commit a capture missing either
     record["events_check_rc"] = events_check_rc(
-        ckpt_root, require_kinds=("compile",)
+        ckpt_root, require_kinds=("compile", "resize")
     )
+    from distributed_training_comparison_tpu.resilience.goodput import (
+        write_goodput,
+    )
+
     write_goodput(out_path, record)
     print(json.dumps({
         "metric": record["metric"],
@@ -849,7 +916,10 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
         "restarts": record["restarts"],
         "preemptions": record["preemptions"],
         "attempts": record["attempts"],
-        "final_rc": summary["final_rc"],
+        "resizes": [
+            (r["from_world"], r["to_world"], r["reason"])
+            for r in record.get("resizes", [])
+        ],
         "platform": platform,
         "full_record": out_path,
     }))

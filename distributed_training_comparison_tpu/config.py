@@ -461,6 +461,56 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "goodput across attempts into GOODPUT.json. CLI-only",
     )
     parser.add_argument(
+        "--fleet-hosts",
+        type=int,
+        default=0,
+        metavar="N",
+        help="Elastic fleet supervision (with --supervise): own N host "
+        "processes per attempt instead of one command, re-rendering "
+        "--world-size/--rank and a fresh --dist-url rendezvous from the "
+        "surviving host pool at every attempt boundary. A host killed by "
+        "a signal (or marked via <ckpt>/fleet/host-i.down) shrinks the "
+        "fleet to the widest legal world size; host-i.up re-admits it and "
+        "triggers a deliberate drain-checkpoint-and-re-expand. 0/1 = the "
+        "single-command supervisor (unchanged)",
+    )
+    parser.add_argument(
+        "--fleet-min-hosts",
+        type=int,
+        default=1,
+        help="Refusal floor for the elastic pool: when no legal world "
+        "size >= this survives (batch divisibility, tensor-parallel "
+        "degree), the supervisor refuses with the actual numbers instead "
+        "of launching a doomed attempt",
+    )
+    parser.add_argument(
+        "--fleet-local-devices",
+        type=int,
+        default=0,
+        help="Devices per fleet host, used to pick the widest legal world "
+        "size AND (CPU emulation: tests/bench) forced into each child via "
+        "XLA_FLAGS. 0 = inherit the environment (real TPU hosts)",
+    )
+    parser.add_argument(
+        "--fleet-grace-secs",
+        type=float,
+        default=15.0,
+        help="Drain grace window: after SIGTERM-ing an attempt's "
+        "surviving ranks (peer died / deliberate resize), ranks still "
+        "alive past this many seconds are SIGKILLed — a host wedged in a "
+        "collective whose peer vanished can never reach its drain poll",
+    )
+    parser.add_argument(
+        "--fleet-poll-secs",
+        type=float,
+        default=1.0,
+        help="Fleet watcher steady-state poll cadence (the event-file "
+        "tail driving stall/alert evaluation). The poll tightens itself "
+        "to ~100ms while any host is degraded (slow/stuck/dead), so "
+        "escalations and recoveries land with sub-second latency without "
+        "paying a fast poll on a healthy fleet",
+    )
+    parser.add_argument(
         "--max-restarts",
         type=int,
         default=3,
@@ -549,6 +599,17 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Check cross-replica param fingerprints every N epochs "
         "(0 disables); any mismatch rolls back — replicas that silently "
         "drifted apart must never keep training",
+    )
+    parser.add_argument(
+        "--health-quarantine",
+        action="store_true",
+        default=False,
+        help="Corrupt-shard quarantine (host data mode): when a rollback "
+        "replays an epoch, the bad step window's batch EXAMPLE indices "
+        "are handed to the loader, which excludes them and deterministically "
+        "substitutes clean examples — a persistently corrupt shard stops "
+        "re-firing the same rollback. Off by default: quarantining changes "
+        "the replayed trajectory, so it is an explicit operator decision",
     )
     parser.add_argument(
         "--health-json",
@@ -699,6 +760,32 @@ def load_config(
         )
     if args.restart_backoff < 0:
         parser.error(f"--restart-backoff must be >= 0, got {args.restart_backoff}")
+    if args.fleet_hosts < 0:
+        parser.error(f"--fleet-hosts must be >= 0, got {args.fleet_hosts}")
+    if args.fleet_hosts > 1 and not args.supervise:
+        parser.error("--fleet-hosts needs --supervise (the elastic pool is "
+                     "a supervisor mode)")
+    if args.fleet_min_hosts < 1:
+        parser.error(
+            f"--fleet-min-hosts must be >= 1, got {args.fleet_min_hosts}"
+        )
+    if args.fleet_local_devices < 0:
+        parser.error(
+            f"--fleet-local-devices must be >= 0, got {args.fleet_local_devices}"
+        )
+    if args.fleet_grace_secs < 0:
+        parser.error(
+            f"--fleet-grace-secs must be >= 0, got {args.fleet_grace_secs}"
+        )
+    if args.fleet_poll_secs <= 0:
+        parser.error(
+            f"--fleet-poll-secs must be > 0, got {args.fleet_poll_secs}"
+        )
+    if args.fleet_hosts > 1 and args.world_size > 1:
+        parser.error(
+            "--fleet-hosts re-renders --world-size/--rank per attempt; "
+            "do not pass --world-size with the elastic pool"
+        )
     if args.flight_recorder_size < 1:
         parser.error(
             f"--flight-recorder-size must be >= 1, got {args.flight_recorder_size}"

@@ -123,17 +123,82 @@ class HostLoader:
         self.num_shards = num_shards
         self.shard = shard
         self.epoch = 0
+        # corrupt-shard quarantine (health/watchdog.py cooperation): example
+        # ids excluded from every future epoch's permutation, each occurrence
+        # substituted IN PLACE by a deterministically drawn clean example —
+        # batch count, shapes, and every untouched batch stay identical, so
+        # a rollback replay differs ONLY where the corrupt data sat
+        self._quarantined: set[int] = set()
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
-    def _indices(self) -> np.ndarray:
+    @property
+    def quarantined(self) -> frozenset:
+        """The excluded example ids (persisted in the resume manifest, so
+        a supervisor relaunch re-applies them — a corrupt shard must not
+        re-enter the stream just because the process restarted)."""
+        return frozenset(self._quarantined)
+
+    def quarantine(self, example_ids) -> int:
+        """Exclude dataset example ids from all future permutations
+        (returns how many NEW ids were added).  The watchdog passes the bad
+        step window's batch indices here on a rollback so the replay skips
+        the corrupt shard instead of re-firing on it.  A refusal (the set
+        would cover the whole dataset) leaves the loader UNCHANGED — a
+        refused quarantine must not poison the next epoch's permutation."""
+        ids = {int(i) for i in np.asarray(example_ids, dtype=np.int64).ravel()}
+        merged = self._quarantined | ids
+        if len(merged) >= len(self.dataset):
+            raise ValueError(
+                f"quarantine would exclude every example "
+                f"({len(merged)} of {len(self.dataset)})"
+            )
+        added = len(merged) - len(self._quarantined)
+        self._quarantined = merged
+        return added
+
+    def batch_example_indices(self, epoch: int, step: int) -> np.ndarray:
+        """The dataset example ids batch ``step`` of ``epoch`` serves (as
+        this loader would iterate them NOW, current quarantine included) —
+        what the trainer hands back to ``quarantine`` when the health
+        watchdog condemns that step's window."""
+        idx = self._permutation(epoch)
+        return idx[step * self.batch_size : (step + 1) * self.batch_size].copy()
+
+    def _permutation(self, epoch: int) -> np.ndarray:
         idx = np.arange(len(self.dataset))
         if self.shuffle:
-            np.random.default_rng((self.seed, self.epoch)).shuffle(idx)
+            np.random.default_rng((self.seed, epoch)).shuffle(idx)
         if self.num_shards > 1:
             idx = shard_indices(idx, self.num_shards, self.shard, even=True)
+        if self._quarantined:
+            quarantined = np.fromiter(self._quarantined, np.int64)
+            bad = np.isin(idx, quarantined)
+            n_bad = int(bad.sum())
+            if n_bad:
+                # substitutes come from THIS loader's own slice of the
+                # epoch (the post-shard permutation): drawing from the
+                # whole dataset would hand this host examples another
+                # host's shard also trains — cross-host duplication.
+                # Falls back to the dataset-wide clean pool only in the
+                # pathological case of a fully-quarantined slice.
+                clean = np.setdiff1d(idx, quarantined)
+                if not len(clean):
+                    clean = np.setdiff1d(
+                        np.arange(len(self.dataset)), quarantined
+                    )
+                # substitutions are a pure function of (seed, epoch, set):
+                # every replay of this loader derives the same permutation
+                rng = np.random.default_rng(
+                    (self.seed, epoch, len(self._quarantined))
+                )
+                idx = idx.copy()
+                idx[bad] = rng.choice(clean, size=n_bad)
         return idx
+
+    def _indices(self) -> np.ndarray:
+        return self._permutation(self.epoch)
 
     def __len__(self) -> int:
         n = len(self._indices())
@@ -179,6 +244,18 @@ class PrefetchLoader:
 
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
+
+    @property
+    def quarantined(self) -> frozenset:
+        return self.loader.quarantined
+
+    def quarantine(self, example_ids) -> int:
+        """Delegate corrupt-shard quarantine to the wrapped loader (the
+        next epoch's producer re-derives its permutation from it)."""
+        return self.loader.quarantine(example_ids)
+
+    def batch_example_indices(self, epoch: int, step: int) -> "np.ndarray":
+        return self.loader.batch_example_indices(epoch, step)
 
     def __len__(self) -> int:
         return len(self.loader)

@@ -32,7 +32,14 @@ Fault injection for all of it lives in ``resilience/faults.py`` (``nan_grad``,
 a deterministic, seeded detect→rollback→converge-anyway e2e test.
 """
 
-from .desync import check_desync, gather_fingerprints, param_fingerprint
+from .desync import (
+    check_desync,
+    check_partial_desync,
+    gather_fingerprints,
+    gather_partial_fingerprints,
+    param_fingerprint,
+    partial_fingerprints,
+)
 from .guards import global_norm, select_tree, step_finite
 from .spike import SpikeDetector
 from .watchdog import (
@@ -45,8 +52,11 @@ from .watchdog import (
 
 __all__ = [
     "check_desync",
+    "check_partial_desync",
     "gather_fingerprints",
+    "gather_partial_fingerprints",
     "param_fingerprint",
+    "partial_fingerprints",
     "global_norm",
     "select_tree",
     "step_finite",

@@ -14,11 +14,19 @@ with a single scalar device→host read per check, then all-gathered across
 processes (a few bytes of DCN traffic).  Replicated params ⇒ bitwise-equal
 fingerprints, so the comparison is exact — ANY spread is a desync.
 
-Caveat: fully *sharded* leaves (tensor-parallel layouts) reduce through a
-collective inside jit, so every process reports the same post-collective
-scalar and per-replica drift in sharded leaves is invisible here; the
-detector targets the replicated (data-parallel) state, which is where
-silent drift actually accumulates.
+The scalar detector has a blind spot: fully *sharded* leaves
+(tensor-parallel layouts) reduce through a collective inside jit, so every
+process reports the same post-collective scalar — per-replica drift INSIDE
+a sharded leaf cancels out of the comparison.  The **partial-reduce
+variant** below closes it: each host sums the shards it actually holds (no
+cross-device reduction anywhere), grouped by mesh coordinate into a
+``(data, model)`` matrix.  Parameters are replicated across the data axis
+by construction, so for every model column the per-data-row partials must
+be bitwise equal; any spread down a column is drift inside that model
+shard — exactly the signal the collective erased.  It costs a host fetch
+of the local shards, so the Trainer runs it only when the model axis is
+actually sharded (``model_parallel > 1``) at the same ``desync_every``
+cadence.
 """
 
 from __future__ import annotations
@@ -52,6 +60,74 @@ def gather_fingerprints(fingerprint: float) -> np.ndarray:
     return np.asarray(
         multihost_utils.process_allgather(np.asarray(fingerprint, np.float32))
     ).reshape(-1)
+
+
+def partial_fingerprints(params, mesh) -> np.ndarray:
+    """Per-device partial checksums as a ``(data, model)`` float64 matrix,
+    computed host-side over each leaf's **addressable** shards with NO
+    cross-device reduction — the same position-weighted per-leaf abs-sum as
+    ``param_fingerprint``, but kept per device so drift inside a sharded
+    leaf stays visible.  Devices this process does not own contribute 0;
+    summing the allgathered matrices across processes (each device is owned
+    by exactly one) rebuilds the full fleet view —
+    ``gather_partial_fingerprints`` does that."""
+    shape = (mesh.shape["data"], mesh.shape["model"])
+    coords = {
+        dev.id: (d, m)
+        for (d, m), dev in np.ndenumerate(mesh.devices)
+    }
+    out = np.zeros(shape, np.float64)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
+        weight = (i % 31) + 1
+        for shard in getattr(leaf, "addressable_shards", ()):
+            pos = coords.get(shard.device.id)
+            if pos is None:
+                continue  # leaf placed off the training mesh
+            out[pos] += float(
+                np.abs(np.asarray(shard.data, np.float64)).sum()
+            ) * weight
+    return out
+
+
+def gather_partial_fingerprints(local: np.ndarray) -> np.ndarray:
+    """Sum every process's local partial matrix into the fleet view (a
+    COLLECTIVE under multi-host — each device is owned by exactly one
+    process, so addition composes the views exactly)."""
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(np.asarray(local, np.float64))
+    )
+    return gathered.reshape((-1,) + local.shape).sum(axis=0)
+
+
+def check_partial_desync(matrix: np.ndarray, *, inject: bool = False) -> dict:
+    """Judge a ``(data, model)`` partial-fingerprint matrix: params are
+    replicated across the data axis, so every model column must be
+    constant down it.  Any spread is per-replica drift inside that model
+    shard — the case the post-collective scalar check cannot see.
+
+    ``inject=True`` perturbs the last data row (the fault-plan seam, like
+    ``check_desync``), so CI drives the detect path deterministically.
+    """
+    m = np.asarray(matrix, np.float64)
+    if m.ndim != 2 or m.size == 0:
+        return {"mismatch": False, "spread": 0.0, "partial": True,
+                "injected": bool(inject)}
+    if inject:
+        m = m.copy()
+        m[-1, :] += np.maximum(1.0, np.abs(m[-1, :]) * 1e-3)
+    per_column = m.max(axis=0) - m.min(axis=0)
+    spread = float(per_column.max())
+    return {
+        "mismatch": bool(spread != 0.0),
+        "spread": spread,
+        "per_model_spread": [float(x) for x in per_column],
+        "partial": True,
+        "injected": bool(inject),
+    }
 
 
 def check_desync(fingerprint: float, *, inject: bool = False) -> dict:

@@ -43,6 +43,7 @@ class HealthConfig:
     desync_every: int = 1     # fingerprint check every N epochs (0 = off)
     min_baseline: int = 16    # good steps required before spikes can flag
     phase_baselines: bool = True  # one baseline per LR phase, not global
+    quarantine: bool = False  # rollback replay skips the bad batch indices
 
     @classmethod
     def from_hparams(cls, hparams) -> "HealthConfig":
@@ -53,6 +54,7 @@ class HealthConfig:
             max_rollbacks=getattr(hparams, "health_max_rollbacks", 3),
             desync_every=getattr(hparams, "health_desync_every", 1),
             phase_baselines=getattr(hparams, "health_phase_baselines", True),
+            quarantine=getattr(hparams, "health_quarantine", False),
         )
 
 
@@ -66,6 +68,9 @@ class EpochVerdict:
     spikes: int         # finite steps flagged by the median/MAD detector
     max_bad_run: int    # longest consecutive run of bad steps
     nonfinite: bool     # any non-finite loss this epoch
+    # within-epoch indices of every bad step (skip|spike) — the window the
+    # corrupt-shard quarantine hands to the loader on rollback
+    bad_steps: list = field(default_factory=list)
 
 
 def _max_run(flags: np.ndarray) -> int:
@@ -105,6 +110,7 @@ class Watchdog:
         self.desyncs = 0
         self.rollback_wasted_steps = 0
         self.rollback_wasted_s = 0.0
+        self.quarantined_examples = 0
         self.events: list[dict] = []
         self._unflushed = 0
 
@@ -170,6 +176,7 @@ class Watchdog:
             spikes=n_spike,
             max_bad_run=max_bad,
             nonfinite=not bool(np.isfinite(losses).all()),
+            bad_steps=np.flatnonzero(bad).tolist(),
         )
 
     def note_desync(self, epoch: int, report: dict) -> None:
@@ -178,6 +185,22 @@ class Watchdog:
             "desync", epoch,
             spread=report.get("spread"),
             injected=report.get("injected", False),
+            **(
+                {"per_host": True}
+                if report.get("partial") else {}
+            ),
+        )
+
+    def note_quarantine(
+        self, epoch: int, steps: list[int], examples: int
+    ) -> None:
+        """Record a corrupt-shard quarantine: the replay of ``epoch`` will
+        exclude the bad step window's batch examples (loader cooperation —
+        ``data/loader.py HostLoader.quarantine``)."""
+        self.quarantined_examples += int(examples)
+        self._event(
+            "quarantine", epoch,
+            steps=[int(s) for s in steps[:16]], examples=int(examples),
         )
 
     # ------------------------------------------------------------- rollback
@@ -221,6 +244,7 @@ class Watchdog:
             "desyncs": self.desyncs,
             "rollback_wasted_steps": self.rollback_wasted_steps,
             "rollback_wasted_s": round(self.rollback_wasted_s, 4),
+            "quarantined_examples": self.quarantined_examples,
         }
 
     def summary(self) -> dict:

@@ -67,8 +67,12 @@ KNOWN_KINDS = {
     "skip", "spike", "rollback", "desync",
     # accounting + gauges
     "writer", "goodput", "metrics", "serve",
-    # supervisor restart loop
+    # supervisor restart loop; `resize` is the elastic fleet supervisor's
+    # world-size re-render (shrink on host loss, re-expand on re-admission)
     "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
+    "resize",
+    # health corrupt-shard quarantine: bad batch indices excluded on replay
+    "quarantine",
     # live fleet operations (obs/heartbeat, straggler, alerts)
     "heartbeat", "stall", "straggler", "alert",
     # compiler observability (obs/compilation): one event per executable

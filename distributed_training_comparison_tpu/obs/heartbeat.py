@@ -165,13 +165,39 @@ class LivenessTracker:
             else DEAD_AFTER_BEATS * interval
         )
         self.stuck_after_beats = max(1, int(stuck_after_beats))
-        # process -> {"last_seen", "state", "epoch", "step", "attempt"}
+        # process -> {"last_seen", "state", "epoch", "step", "attempt"}.
+        # Locked: the supervisor thread resets the tracker at attempt
+        # boundaries while the watcher thread observes/classifies — an
+        # unguarded dict resize mid-iteration would kill the watcher.
         self._procs: dict[int, dict] = {}
+        self._lock = threading.Lock()
 
-    def reset(self) -> None:
+    def reset(
+        self, expect=None, attempt: int = 0, now: float | None = None
+    ) -> None:
         """Forget every tracked process (between supervised attempts: the
-        backoff gap must not read as the whole fleet dying)."""
-        self._procs.clear()
+        backoff gap must not read as the whole fleet dying).
+
+        ``expect`` (an iterable of process indices) pre-registers the
+        attempt's LAUNCH SET: a host that never emits a single event —
+        crashed in early init, wedged before its first beat — is otherwise
+        invisible to the tracker (it only folds what it has seen), and the
+        elastic supervisor re-renders that set every attempt.  Seeded
+        entries age from ``now`` like a real observation, so a silent
+        expected host escalates through the normal slow classification
+        (the pre-first-beat cap still applies — early silence is usually
+        the first dispatch's compile)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._procs.clear()
+            if expect is None:
+                return
+            for p in expect:
+                self._procs[int(p)] = {
+                    "last_seen": now, "state": "ok", "epoch": None,
+                    "step": None, "attempt": int(attempt), "beats": 0,
+                    "beats_at_step": 0,
+                }
 
     def observe(self, ev: dict, now: float | None = None) -> None:
         if not isinstance(ev, dict):
@@ -181,11 +207,12 @@ class LivenessTracker:
             return
         p = int(ev.get("process_index", 0))
         now = time.monotonic() if now is None else now
-        rec = self._procs.setdefault(
-            p, {"last_seen": now, "state": "ok", "epoch": None, "step": None,
-                "attempt": int(ev.get("attempt", 0)), "beats": 0,
-                "beats_at_step": 0}
-        )
+        with self._lock:
+            rec = self._procs.setdefault(
+                p, {"last_seen": now, "state": "ok", "epoch": None,
+                    "step": None, "attempt": int(ev.get("attempt", 0)),
+                    "beats": 0, "beats_at_step": 0}
+            )
         rec["last_seen"] = now
         rec["attempt"] = int(ev.get("attempt", rec["attempt"] or 0))
         if kind == HEARTBEAT_KIND:
@@ -204,24 +231,27 @@ class LivenessTracker:
 
     def ages(self, now: float | None = None) -> dict[str, float]:
         now = time.monotonic() if now is None else now
+        with self._lock:
+            items = sorted(self._procs.items())
         return {
-            f"p{p}": max(0.0, now - rec["last_seen"])
-            for p, rec in sorted(self._procs.items())
+            f"p{p}": max(0.0, now - rec["last_seen"]) for p, rec in items
         }
 
     def states(self) -> dict[int, str]:
-        return {p: rec["state"] for p, rec in self._procs.items()}
+        with self._lock:
+            return {p: rec["state"] for p, rec in self._procs.items()}
 
     def check(self, now: float | None = None) -> list[dict]:
         """Classify every tracked process; return the transitions."""
         now = time.monotonic() if now is None else now
+        with self._lock:
+            snapshot = sorted(self._procs.items())
         fleet_step = max(
-            (rec["step"] for rec in self._procs.values()
-             if rec["step"] is not None),
+            (rec["step"] for _, rec in snapshot if rec["step"] is not None),
             default=None,
         )
         out = []
-        for p, rec in sorted(self._procs.items()):
+        for p, rec in snapshot:
             age = now - rec["last_seen"]
             if age > self.dead_after_s:
                 state = "dead"
@@ -320,7 +350,16 @@ class FleetWatcher:
     tails (e.g. to keep the exporter's fleet state fresh).  ``start`` /
     ``stop`` bracket one supervised run; ``step()`` runs one poll cycle
     synchronously (tests drive it with a fake clock).
+
+    The poll is **adaptive**: ``poll_s`` (the ``--fleet-poll-secs`` knob)
+    is the steady-state cadence, but while any tracked host is in a
+    degraded state (``slow``/``stuck``/``dead``) the watcher tightens to
+    ``fast_poll_s`` (~100 ms) so the escalation to ``dead`` — and the
+    recovery call — land with sub-second latency instead of one full poll
+    late.  A healthy fleet keeps paying the cheap 1 Hz file stat.
     """
+
+    FAST_POLL_S = 0.1
 
     def __init__(
         self,
@@ -329,14 +368,28 @@ class FleetWatcher:
         tracker: LivenessTracker | None = None,
         engine=None,
         poll_s: float = 1.0,
+        fast_poll_s: float | None = None,
     ) -> None:
         self.tailer = EventTailer(root)
         self.bus = bus
         self.tracker = tracker
         self.engine = engine
         self.poll_s = float(poll_s)
+        self.fast_poll_s = min(
+            self.poll_s,
+            self.FAST_POLL_S if fast_poll_s is None else float(fast_poll_s),
+        )
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def current_poll_s(self) -> float:
+        """The next poll interval: the base cadence, tightened while any
+        tracked host is degraded (a transition is likely imminent)."""
+        if self.tracker is not None and any(
+            state != "ok" for state in self.tracker.states().values()
+        ):
+            return self.fast_poll_s
+        return self.poll_s
 
     def step(self, now: float | None = None) -> list[dict]:
         """One poll cycle; returns the events it consumed."""
@@ -365,7 +418,7 @@ class FleetWatcher:
                 self.step()
             except Exception:  # watching must never kill supervising
                 pass
-            self._stop.wait(self.poll_s)
+            self._stop.wait(self.current_poll_s())
 
     def start(self) -> "FleetWatcher":
         if self._thread is None:

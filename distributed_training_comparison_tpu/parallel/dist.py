@@ -26,6 +26,13 @@ def init_distributed(hparams) -> None:
     names (``src/ddp/config.py:21-26``) but count *hosts*.  A world size of
     1 (or TPU auto-bootstrap environments where the flags are left at their
     defaults) needs no rendezvous.
+
+    Under elastic fleet supervision (``resilience/fleet.py``) these three
+    flags are **per-attempt variables**, not run constants: every attempt
+    is a fresh set of processes whose world size/ranks are re-rendered
+    from the surviving host pool, with a FRESH coordinator port — so this
+    once-per-process initialize is exactly the right shape (there is no
+    in-process re-init to support; a resized fleet is new processes).
     """
     world = getattr(hparams, "world_size", 1)
     if world <= 1:

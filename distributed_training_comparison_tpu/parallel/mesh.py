@@ -42,6 +42,25 @@ def mesh_shape_for_backend(
     return (num_devices // model_parallel, model_parallel)
 
 
+def elastic_mesh_shape(
+    num_devices: int, model_parallel: int = 1
+) -> tuple[int, int] | None:
+    """Re-derive the ``(data, model)`` axes for a RE-RENDERED device count
+    (elastic shrink/expand), or ``None`` when no legal mesh exists at that
+    count — the model axis cannot shrink below the tensor-parallel degree,
+    and the devices must tile it evenly.  The elastic supervisor uses this
+    to pick the widest legal world size before launching an attempt, and
+    ``resilience/elastic.py::validate_reshard`` to refuse (with numbers)
+    instead of tracing into a doomed jit."""
+    if num_devices < 1 or model_parallel < 1:
+        return None
+    if num_devices < model_parallel or num_devices % model_parallel:
+        return None
+    # one source of truth for the axis arithmetic: the same function every
+    # mesh construction goes through (this wrapper only adds None-on-illegal)
+    return mesh_shape_for_backend("tpu", num_devices, model_parallel)
+
+
 def make_mesh(
     num_devices: int = 0,
     model_parallel: int = 1,

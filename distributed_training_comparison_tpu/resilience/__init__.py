@@ -17,8 +17,15 @@ makes failure a first-class, testable code path:
                    verify-on-restore with previous-good rotation;
 - ``supervisor`` — restart loop with exponential backoff + max-restart
                    budget, resuming from the newest *valid* checkpoint;
+- ``fleet``      — elastic fleet supervision: a host pool whose world size
+                   is re-rendered per attempt (``--world-size``/``--rank``/
+                   fresh ``--dist-url``), shrinking on host loss and
+                   re-expanding — via a deliberate drain — when a host
+                   returns; ``resize`` events price every change;
 - ``elastic``    — restoring onto a different device count / mesh shape
-                   than the state was saved under;
+                   than the state was saved under, with an explicit reshard
+                   validation step (``validate_reshard``) that refuses with
+                   actionable numbers when no legal mesh exists;
 - ``goodput``    — productive step time vs. checkpoint / restart / recovery
                    time, aggregated across restarts into ``GOODPUT.json``.
 """
@@ -33,8 +40,16 @@ from .ckpt_io import (
     verify_checkpoint,
     write_manifest,
 )
-from .elastic import describe_restore, forced_host_device_env, topology
+from .elastic import (
+    ReshardError,
+    describe_restore,
+    divisibility_help,
+    forced_host_device_env,
+    topology,
+    validate_reshard,
+)
 from .faults import FaultEvent, FaultPlan, FaultSpecError
+from .fleet import FleetPlanError, FleetSupervisor, widest_legal_world
 from .goodput import GoodputMeter, aggregate_goodput, load_goodput_records
 from .preempt import EXIT_PREEMPTED, Preempted, PreemptionHandler
 from .supervisor import Supervisor
@@ -49,8 +64,14 @@ __all__ = [
     "verify_checkpoint",
     "write_manifest",
     "describe_restore",
+    "divisibility_help",
     "forced_host_device_env",
     "topology",
+    "validate_reshard",
+    "ReshardError",
+    "FleetPlanError",
+    "FleetSupervisor",
+    "widest_legal_world",
     "FaultEvent",
     "FaultPlan",
     "FaultSpecError",

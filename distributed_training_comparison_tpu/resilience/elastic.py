@@ -74,6 +74,96 @@ def mesh_meta(mesh) -> dict:
     return {"mesh": dict(mesh.shape), **topology()}
 
 
+class ReshardError(ValueError):
+    """No legal mesh/batch split exists for the re-rendered topology.  The
+    message always carries the actual numbers plus the nearest legal
+    alternatives — an operator resizing a fleet at 3am acts on "use batch
+    96 or run 2 hosts", not on a bare divisibility traceback."""
+
+
+def _divisors(n: int, cap: int) -> list[int]:
+    return [d for d in range(1, cap + 1) if n % d == 0]
+
+
+def divisibility_help(
+    batch_size: int, data_axis: int, grad_accum: int = 1
+) -> str:
+    """The actionable tail of every batch-divisibility refusal: which
+    data-parallel widths THIS batch supports, and the nearest batch sizes
+    that would support THIS width."""
+    unit = max(1, grad_accum)
+    legal_axes = (
+        _divisors(batch_size // unit, max(data_axis, 1))
+        if batch_size and batch_size % unit == 0
+        else []
+    )
+    lower = (batch_size // (data_axis * unit)) * data_axis * unit
+    upper = lower + data_axis * unit
+    parts = [
+        f"global batch {batch_size} is not divisible by "
+        f"data-parallel size {data_axis}"
+        + (f" x grad_accum {grad_accum}" if grad_accum > 1 else "")
+    ]
+    if legal_axes:
+        parts.append(
+            f"legal data-parallel sizes for this batch: "
+            f"{legal_axes[-8:]}"
+        )
+    parts.append(
+        f"nearest legal batch sizes at width {data_axis}: "
+        f"{[b for b in (lower, upper) if b > 0]}"
+    )
+    return "; ".join(parts)
+
+
+def validate_reshard(
+    manifest: dict | None,
+    mesh,
+    *,
+    batch_size: int,
+    grad_accum: int = 1,
+) -> dict:
+    """The explicit reshard step of an elastic restore: validate the saved
+    mesh against the re-rendered one and the global batch against the new
+    data axis, and return the reshard plan — what changed and how state
+    will be re-placed.  Raises :class:`ReshardError` (with the numbers and
+    the nearest legal alternatives) only when no legal split exists; a
+    topology change by itself is fine, that is the whole point of the
+    host-pytree checkpoint format.
+
+    The Trainer runs this after reading the resume manifest; the fleet
+    supervisor runs the same arithmetic (``parallel.mesh
+    .elastic_mesh_shape`` + the divisibility rule) BEFORE launching a
+    shrunk attempt, so a doomed world size is refused at the launch
+    boundary, not after a full process start + compile.
+    """
+    now_shape = dict(mesh.shape)
+    data_axis = int(now_shape.get("data", 1))
+    unit = data_axis * max(1, grad_accum)
+    if batch_size % unit:
+        raise ReshardError(
+            "elastic reshard refused: "
+            + divisibility_help(batch_size, data_axis, grad_accum)
+            + f" (restoring onto mesh {now_shape})"
+        )
+    saved_mesh = (manifest or {}).get("mesh")
+    saved_devices = (manifest or {}).get("devices")
+    changed = bool(manifest) and (
+        saved_mesh != now_shape
+        or saved_devices not in (None, jax.device_count())
+    )
+    return {
+        "changed": changed,
+        "saved_mesh": saved_mesh,
+        "saved_devices": saved_devices,
+        "saved_processes": (manifest or {}).get("processes"),
+        "mesh": now_shape,
+        "devices": jax.device_count(),
+        "processes": jax.process_count(),
+        "per_device_batch": batch_size // data_axis,
+    }
+
+
 def describe_restore(manifest: dict | None, mesh) -> str | None:
     """A human-readable elastic-restore notice, or None when the topology is
     unchanged (or the checkpoint predates manifests)."""

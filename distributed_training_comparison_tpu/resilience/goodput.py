@@ -135,14 +135,21 @@ def aggregate_goodput(
     downtime_s: float = 0.0,
     restarts: int = 0,
     preemptions: int = 0,
+    resizes: list[dict] | None = None,
 ) -> dict:
     """Fold per-attempt records + supervisor downtime into the GOODPUT.json
-    shape: totals per phase, overall goodput, and the attempt list."""
+    shape: totals per phase, overall goodput, and the attempt list.
+    ``resizes`` (the elastic fleet supervisor's world-size changes) ride
+    into the report so the scoreboard prices every shrink/expand next to
+    the goodput it cost."""
     totals = {f"{k}_s": 0.0 for k in PHASES}
     totals["wall_s"] = 0.0
     totals["untracked_s"] = 0.0
     writer_busy = 0.0
-    health = {"skipped_steps": 0, "spike_steps": 0, "rollbacks": 0, "desyncs": 0}
+    health = {
+        "skipped_steps": 0, "spike_steps": 0, "rollbacks": 0, "desyncs": 0,
+        "quarantined_examples": 0,
+    }
     for rec in records:
         for key in totals:
             totals[key] += float(rec.get(key, 0.0))
@@ -170,6 +177,8 @@ def aggregate_goodput(
         "health": health,
         "attempt_records": records,
     }
+    if resizes is not None:
+        out["resizes"] = list(resizes)
     if len(run_ids) == 1:
         out["run_id"] = next(iter(run_ids))
     return out

@@ -1,7 +1,10 @@
 """Preemption: catch the signal, drain state to disk, exit distinctly.
 
 Cloud schedulers (GCE preemptible/spot TPU VMs, k8s eviction) deliver
-SIGTERM and grant a grace window before SIGKILL.  The reference repo dies
+SIGTERM and grant a grace window before SIGKILL.  The elastic fleet
+supervisor (``fleet.py``) speaks the same protocol from the inside: its
+deliberate drains (peer died, world resize) SIGTERM the surviving ranks
+and SIGKILL past ``--fleet-grace-secs`` — one drain path, whoever asks.  The reference repo dies
 mid-epoch and loses everything since the last manual save; here the Trainer
 polls a ``PreemptionHandler`` at epoch boundaries, and on a pending signal
 drains the ``AsyncCheckpointer``, forces a final ``last.ckpt``, and raises

@@ -29,6 +29,14 @@ def _default_runner(cmd: Sequence[str], env: dict | None) -> int:
     return subprocess.run(list(cmd), env=env).returncode
 
 
+class PlanRefused(RuntimeError):
+    """Raised by ``_plan_attempt`` when no legal next attempt can be
+    rendered (the elastic fleet's world-size refusal).  Before the first
+    attempt it propagates — a config error belongs at the CLI; mid-run it
+    stops the loop ORDERLY, so the completed attempts' summary (and the
+    caller's goodput aggregation) survive the refusal."""
+
+
 class Supervisor:
     """Relaunch a command until success, a budget, or an unretryable exit.
 
@@ -84,6 +92,31 @@ class Supervisor:
         env = self._env(attempt) if callable(self._env) else self._env
         return list(cmd), env
 
+    # -- subclass seams (the elastic FleetSupervisor re-renders the launch
+    # -- set per attempt; the base class runs one static command) --------
+
+    def _plan_attempt(self, attempt: int) -> None:
+        """Decide this attempt's launch set BEFORE ``attempt_start`` is
+        emitted (the fleet supervisor re-renders world size here and emits
+        ``resize`` events)."""
+
+    def _attempt_info(self) -> dict:
+        """Extra payload for this attempt's ``attempt_start``/``attempt_end``
+        events and its summary record (the fleet supervisor reports
+        ``world_size`` and the host set)."""
+        return {}
+
+    def _attempt_free(self, rc: int, preempted: bool) -> bool:
+        """True when this attempt must not consume the restart budget — a
+        DELIBERATE supervisor-initiated drain (re-expansion after a host
+        returned) is planned work, not a failure."""
+        return False
+
+    def _launch(self, attempt: int) -> int:
+        """Run one attempt to completion; returns its exit code."""
+        cmd, env = self._resolve(attempt)
+        return self._runner(cmd, env)
+
     def run(self) -> dict:
         """The restart loop.  Returns a summary dict::
 
@@ -94,21 +127,29 @@ class Supervisor:
         attempts: list[dict] = []
         crashes = 0
         preemptions = 0
+        planned_drains = 0
         progress_restarts = 0
         budget_used = 0
         downtime = 0.0
         attempt = 0
         prev_marker = self._progress() if self._progress is not None else None
         while True:
-            cmd, env = self._resolve(attempt)
-            self._events("attempt_start", attempt=attempt)
+            try:
+                self._plan_attempt(attempt)
+            except PlanRefused as e:
+                if not attempts:
+                    raise  # pre-first-attempt refusal = config error
+                self._log(f"stopping after {len(attempts)} attempt(s): {e}")
+                break
+            info = self._attempt_info()
+            self._events("attempt_start", attempt=attempt, **info)
             t0 = time.monotonic()
-            rc = self._runner(cmd, env)
+            rc = self._launch(attempt)
             seconds = time.monotonic() - t0
             preempted = rc == self.preempt_exit_code
             self._events(
                 "attempt_end", attempt=attempt, returncode=rc,
-                seconds=round(seconds, 3), preempted=preempted,
+                seconds=round(seconds, 3), preempted=preempted, **info,
             )
             attempts.append(
                 {
@@ -116,6 +157,7 @@ class Supervisor:
                     "returncode": rc,
                     "seconds": round(seconds, 3),
                     "preempted": preempted,
+                    **info,
                 }
             )
             if rc == 0:
@@ -127,10 +169,16 @@ class Supervisor:
                 prev_marker = marker
                 attempts[-1]["progress"] = progressed
             if preempted:
-                # counted before the budget check so a final preempted
-                # attempt that exhausts the budget still shows up
-                preemptions += 1
-                budget_used += 1
+                if self._attempt_free(rc, True):
+                    # a DELIBERATE supervisor-initiated drain (the elastic
+                    # re-expand) is planned work: neither a preemption on
+                    # the scoreboard nor a draw on the restart budget
+                    planned_drains += 1
+                else:
+                    # counted before the budget check so a final preempted
+                    # attempt that exhausts the budget still shows up
+                    preemptions += 1
+                    budget_used += 1
             elif progressed:
                 # the attempt advanced the durable checkpoint (e.g. health
                 # rollbacks kept writing progress before the budget ran
@@ -177,26 +225,37 @@ class Supervisor:
             "final_rc": attempts[-1]["returncode"],
             "restarts": len(attempts) - 1,
             "preemptions": preemptions,
+            "planned_drains": planned_drains,
             "progress_restarts": progress_restarts,
             "downtime_s": round(downtime, 3),
             "attempts": attempts,
         }
 
 
-def strip_resume_flag(args: Sequence[str]) -> list[str]:
-    """Drop an explicit ``--resume PATH`` (either flag form) from an argv."""
+def strip_flags(args: Sequence[str], names: Sequence[str]) -> list[str]:
+    """Drop ``--flag VALUE`` / ``--flag=VALUE`` occurrences of every named
+    flag from an argv — ONE stripping implementation for the restart loop
+    (``--resume``) and the fleet's per-rank re-render (``--world-size``/
+    ``--rank``/``--dist-url``/the parent-only ``--fleet-*`` flags)."""
+    names = tuple(names)
+    prefixed = tuple(f"{n}=" for n in names)
     out, skip = [], False
     for a in args:
         if skip:
             skip = False
             continue
-        if a == "--resume":
+        if a in names:
             skip = True
             continue
-        if a.startswith("--resume="):
+        if a.startswith(prefixed):
             continue
         out.append(a)
     return out
+
+
+def strip_resume_flag(args: Sequence[str]) -> list[str]:
+    """Drop an explicit ``--resume PATH`` (either flag form) from an argv."""
+    return strip_flags(args, ("--resume",))
 
 
 def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
@@ -293,7 +352,12 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
         fleet=True,
     )
     watcher = (
-        obs.FleetWatcher(hparams.ckpt_path, bus, tracker=tracker, engine=engine)
+        obs.FleetWatcher(
+            hparams.ckpt_path, bus, tracker=tracker, engine=engine,
+            # steady-state cadence; the watcher tightens itself to ~100ms
+            # while any host is degraded (obs/heartbeat.py adaptive poll)
+            poll_s=getattr(hparams, "fleet_poll_secs", 1.0),
+        )
         if obs_enabled
         else None
     )
@@ -312,9 +376,16 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
             # fresh liveness + fleet-aggregate folds per attempt: the
             # previous attempt's death and the backoff gap must not read
             # as this one's fleet stalling, and its processes' last
-            # window values must not hold a sum() rule in breach
+            # window values must not hold a sum() rule in breach.  The
+            # elastic path re-renders the launch set every attempt, so the
+            # tracker is seeded with the EXPECTED ranks — a host that
+            # never emits a single event still gets a stall call.
             if tracker is not None:
-                tracker.reset()
+                world = int(payload.get("world_size") or 0)
+                tracker.reset(
+                    expect=range(world) if world > 0 else None,
+                    attempt=int(payload.get("attempt", 0)),
+                )
             engine.reset_fleet()
         if kind == "attempt_end" and obs_enabled:
             # the black-box pull: decode every host's mmap flight ring
@@ -341,14 +412,24 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
             if tracker is not None:
                 tracker.reset()
 
-    sup = Supervisor(
-        cmd_for,
-        env=env_for,
+    fleet_hosts = int(getattr(hparams, "fleet_hosts", 0) or 0)
+    policy = dict(
         max_restarts=getattr(hparams, "max_restarts", 3),
         backoff_base=getattr(hparams, "restart_backoff", 1.0),
         progress=progress_probe,
         events=on_event,
     )
+    if fleet_hosts > 1:
+        # the elastic pool: N host processes per attempt, world size
+        # re-rendered from the surviving hosts at every boundary
+        from .fleet import FleetSupervisor, fleet_env_knobs
+
+        sup = FleetSupervisor(
+            cmd_for, env=env_for, ckpt_root=hparams.ckpt_path,
+            **fleet_env_knobs(hparams), **policy,
+        )
+    else:
+        sup = Supervisor(cmd_for, env=env_for, **policy)
     t_start = time.time()
     if watcher is not None:
         watcher.start()
@@ -368,8 +449,14 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
         downtime_s=summary["downtime_s"],
         restarts=summary["restarts"],
         preemptions=summary["preemptions"],
+        resizes=summary.get("resizes"),
     )
     report.setdefault("run_id", run_id)
+    # the restart-loop ledger rides into the scoreboard: per-attempt
+    # return codes (and, elastic, world sizes/hosts), the planned-drain
+    # count, downtime — a GOODPUT.json reader can tell a budget-free
+    # re-expand drain from a crash restart without the event stream
+    report["supervisor"] = summary
     out_path = getattr(hparams, "goodput_json", None) or "GOODPUT.json"
     write_goodput(out_path, report)
     bus.emit(

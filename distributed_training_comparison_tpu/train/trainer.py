@@ -138,13 +138,14 @@ class Trainer:
         if self.grad_accum < 1:
             raise ValueError(f"--grad-accum must be >= 1, got {self.grad_accum}")
         if hparams.batch_size % (self.grad_accum * n_data):
-            detail = (
-                f"grad_accum ({self.grad_accum}) x data-parallel size ({n_data})"
-                if self.grad_accum > 1
-                else f"data-parallel size {n_data}"
-            )
+            # actionable numbers, not a bare divisibility traceback: the
+            # elastic supervisor's operator acts on "legal widths for this
+            # batch" / "nearest legal batches at this width"
             raise ValueError(
-                f"global batch {hparams.batch_size} not divisible by {detail}"
+                "global batch does not split over this mesh: "
+                + elastic.divisibility_help(
+                    hparams.batch_size, n_data, self.grad_accum
+                )
             )
 
         self.root_key = fix_seed(hparams.seed)
@@ -500,6 +501,7 @@ class Trainer:
         # would otherwise have nothing to roll back to — the (read-only)
         # source checkpoint is exactly the state the run started from
         self._rollback_source = getattr(hparams, "resume", None)
+        self._reshard = None  # the elastic reshard plan, set on resume
         if getattr(hparams, "resume", None):
             if resume_bytes is None:
                 # explicit --resume: one read-and-hash pass (the checksum
@@ -531,9 +533,38 @@ class Trainer:
                 f"(best acc {self.best_acc:.4f})"
             )
             manifest = read_manifest(hparams.resume)
+            # the explicit reshard step of an elastic restore: validate the
+            # saved mesh against THIS run's re-rendered one and the batch
+            # against the new data axis (raises ReshardError with the
+            # numbers when no legal split exists — the construction-time
+            # divisibility check above already caught the batch half, so
+            # this mostly records the topology delta for the restore log
+            # and the run_start payload)
+            self._reshard = elastic.validate_reshard(
+                manifest, self.mesh,
+                batch_size=hparams.batch_size, grad_accum=self.grad_accum,
+            )
             elastic_msg = elastic.describe_restore(manifest, self.mesh)
             if elastic_msg:
                 self.logger.info(elastic_msg)
+            if (
+                manifest
+                and manifest.get("quarantined")
+                and hasattr(self.train_loader, "quarantine")
+            ):
+                # corrupt-shard quarantine survives the relaunch: re-apply
+                # the manifest's excluded example ids to the fresh loader
+                try:
+                    n = self.train_loader.quarantine(manifest["quarantined"])
+                except ValueError as e:
+                    self.logger.error(
+                        f"health: persisted quarantine not re-applied: {e}"
+                    )
+                else:
+                    self.logger.info(
+                        f"health: re-applied persisted quarantine "
+                        f"({n} example(s) excluded)"
+                    )
             if manifest and manifest.get("epoch_in_progress") == self.start_epoch:
                 # both data modes fast-forward exactly: the loader order and
                 # the per-step keys (host mode) / the epoch permutation and
@@ -580,9 +611,11 @@ class Trainer:
             steps_per_epoch=self.steps_per_epoch,
             batch_size=hparams.batch_size,
             mesh=dict(self.mesh.shape),
+            world_size=jax.process_count(),
             data_mode=self.data_mode,
             precision=self.precision,
             resumed=bool(getattr(hparams, "resume", None)),
+            resharded=bool(self._reshard and self._reshard["changed"]),
             resume_step_offset=self._resume_step_offset,
             init_s=round(self._init_secs, 4),
         )
@@ -699,12 +732,22 @@ class Trainer:
     def _ckpt_meta(self) -> dict:
         """Manifest metadata every resumable save carries: the saving mesh
         topology (elastic-restore accounting) plus the run identity, so a
-        checkpoint names the run/attempt that wrote it."""
-        return {
+        checkpoint names the run/attempt that wrote it.  A non-empty
+        corrupt-shard quarantine rides along too — a supervisor relaunch
+        must re-apply it, or the quarantined examples re-enter the stream
+        and re-fire the very rollback the quarantine exists to stop.
+        (Multi-host caveat: only process 0 writes the manifest, so only
+        its shard's set survives a relaunch — acceptable for the opt-in
+        flag; noted in ROADMAP.)"""
+        meta = {
             **elastic.mesh_meta(self.mesh),
             "run_id": self.bus.run_id,
             "attempt": self.bus.attempt,
         }
+        quarantined = getattr(self.train_loader, "quarantined", None)
+        if quarantined:
+            meta["quarantined"] = sorted(quarantined)
+        return meta
 
     def _dump_hparams(self) -> None:
         """hparams.yaml provenance dump (reference ``src/single/trainer.py:70-73``)."""
@@ -1167,7 +1210,7 @@ class Trainer:
             self.bus.dump_crash(msg, directory=self._obs_dir)
             raise RuntimeError(msg)
         with self.tracer.span("rollback", epoch=epoch):
-            next_epoch = self._rollback(epoch, epoch_time, reason)
+            next_epoch = self._rollback(epoch, epoch_time, reason, verdict)
         if next_epoch is None:  # nothing to roll back to
             if verdict.nonfinite or verdict.skipped:
                 self._abort_nonfinite(
@@ -1185,22 +1228,49 @@ class Trainer:
     def _desync_check(self, inject: bool) -> dict:
         """Param fingerprint, all-gathered and compared across processes (a
         COLLECTIVE under multi-host — reached identically by every process).
-        One scalar device→host read; see health/desync.py."""
+        One scalar device→host read; see health/desync.py.
+
+        When the model axis is actually sharded (``model_parallel > 1``)
+        the post-collective scalar is blind to per-replica drift INSIDE the
+        sharded leaves, so a partial-reduce pass (per-device checksums
+        grouped by mesh coordinate, compared down the replicated data axis)
+        runs alongside it — it costs a host fetch of the local shards, so
+        it is gated to the meshes that have the blind spot."""
         if self._fingerprint_fn is None:
             self._fingerprint_fn = self.compile_monitor.instrument(
                 jax.jit(param_fingerprint), "param_fingerprint",
                 sentinel=False,
             )
-        return check_desync(
+        report = check_desync(
             float(self._fingerprint_fn(self.state.params)), inject=inject
         )
+        if self.mesh.shape["model"] > 1 and not report["mismatch"]:
+            from ..health import (
+                check_partial_desync,
+                gather_partial_fingerprints,
+                partial_fingerprints,
+            )
 
-    def _rollback(self, epoch: int, epoch_time: float, reason: str) -> int | None:
+            partial = check_partial_desync(
+                gather_partial_fingerprints(
+                    partial_fingerprints(self.state.params, self.mesh)
+                )
+            )
+            if partial["mismatch"]:
+                report = {**partial, "injected": inject}
+        return report
+
+    def _rollback(
+        self, epoch: int, epoch_time: float, reason: str, verdict=None
+    ) -> int | None:
         """Restore the last good checkpoint (verified bytes, prev- fallback)
         and return the epoch to replay from; None when no verified
         checkpoint exists.  The epoch(s) being discarded move from the
         goodput 'step' phase to 'rollback' — wasted compute must not count
-        as productive."""
+        as productive.  With ``--health-quarantine`` (host data mode) the
+        bad step window's batch example indices are handed to the loader
+        before the replay, so a persistently corrupt shard cannot re-fire
+        the same rollback."""
         if self.ckpt_writer is not None:
             # drain in-flight saves so the newest last.ckpt is durable
             # before it is read back; a failed save falls through to the
@@ -1280,6 +1350,38 @@ class Trainer:
             )
         self.state = place_tree(state, self.state_sharding)
         self.best_acc = best
+        # corrupt-shard quarantine (--health-quarantine, host data mode):
+        # the replay must not re-train the condemned window's examples —
+        # the loader substitutes deterministically drawn clean ones, so a
+        # corrupt shard that deterministically re-fires stops doing so.
+        # Each host quarantines its OWN shard's slice of the bad steps (the
+        # verdict is replicated, so the decision is symmetric).
+        if (
+            self.watchdog.cfg.quarantine
+            and verdict is not None
+            and verdict.bad_steps
+            and self.train_loader is not None
+            and hasattr(self.train_loader, "quarantine")
+        ):
+            step_base = self._epoch_step_base
+            bad_steps = [step_base + int(s) for s in verdict.bad_steps]
+            try:
+                ids = np.concatenate(
+                    [
+                        self.train_loader.batch_example_indices(epoch, s)
+                        for s in bad_steps
+                    ]
+                )
+                added = self.train_loader.quarantine(ids)
+            except ValueError as e:  # quarantining everything is worse
+                self.logger.error(f"health: quarantine refused: {e}")
+            else:
+                self.watchdog.note_quarantine(epoch, bad_steps, added)
+                self.logger.warning(
+                    f"health: quarantined {added} example(s) from the bad "
+                    f"step window {bad_steps[:8]} of epoch {epoch}; the "
+                    "replay substitutes clean examples"
+                )
         self._resume_step_offset = 0  # a rollback replays whole epochs
         wasted_epochs = max(1, epoch - next_epoch + 1)
         wasted_s = self.goodput.transfer(

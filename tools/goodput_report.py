@@ -87,6 +87,22 @@ def format_table(reports: list[tuple[str, dict]]) -> str:
     ]
     for name, run_id, attempts in tagged:
         lines.append(f"  {name}: run {run_id} ({attempts} attempt(s))")
+    # the elastic pool's shrink/expand rows: every world-size change the
+    # fleet supervisor rendered, priced next to the goodput it cost
+    for name, rep in reports:
+        for rz in rep.get("resizes") or []:
+            delta = []
+            if rz.get("lost"):
+                delta.append(f"lost {rz['lost']}")
+            if rz.get("returned"):
+                delta.append(f"returned {rz['returned']}")
+            lines.append(
+                f"  {name}: resize a{rz.get('attempt', '?')} world "
+                f"{rz.get('from_world', '?')} -> {rz.get('to_world', '?')} "
+                f"({rz.get('reason', '?')}"
+                + (f"; {', '.join(delta)}" if delta else "")
+                + ")"
+            )
     return "\n".join(lines)
 
 

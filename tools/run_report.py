@@ -85,9 +85,11 @@ from distributed_training_comparison_tpu.obs import (  # noqa: E402
 
 TIMELINE_TAIL = 20
 # supervisor-side kinds: their envelope attempt is the supervisor's own
-# (0); the payload names the child attempt they concern
+# (0); the payload names the child attempt they concern.  `resize` is the
+# elastic fleet supervisor's world-size re-render (shrink/expand).
 SUPERVISOR_KINDS = {
     "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
+    "resize",
 }
 # live-operations kinds: summarized fleet-wide, not per attempt (stall/
 # straggler/alert payloads name the attempt+process they concern)
@@ -545,6 +547,36 @@ def format_summary(name: str, s: dict) -> str:
                 else ""
             )
             + ")"
+        )
+    # the elastic fleet's per-attempt world sizes + resize timeline: the
+    # attempt_start payloads carry the re-rendered launch set, resize
+    # events the shrink/expand decisions (ISSUE 10)
+    worlds = {}
+    for ev in s["supervisor"]:
+        p = _payload(ev)
+        if ev["kind"] == "attempt_start" and p.get("world_size"):
+            worlds[p.get("attempt", "?")] = (
+                p["world_size"], p.get("hosts")
+            )
+        elif ev["kind"] == "resize":
+            delta = []
+            if p.get("lost"):
+                delta.append(f"lost {p['lost']}")
+            if p.get("returned"):
+                delta.append(f"returned {p['returned']}")
+            lines.append(
+                f"  resize (attempt {p.get('attempt', '?')}): world "
+                f"{p.get('from_world', '?')} -> {p.get('to_world', '?')} "
+                f"({p.get('reason', '?')}"
+                + (f"; {', '.join(delta)}" if delta else "")
+                + ")"
+            )
+    if worlds:
+        lines.append(
+            "  world sizes: " + ", ".join(
+                f"a{a}={w}" + (f" hosts={h}" if h else "")
+                for a, (w, h) in sorted(worlds.items(), key=lambda kv: str(kv[0]))
+            )
         )
     if s["supervisor"]:
         sup = ", ".join(
