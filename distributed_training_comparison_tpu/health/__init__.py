@@ -37,6 +37,7 @@ from .desync import (
     check_partial_desync,
     gather_fingerprints,
     gather_partial_fingerprints,
+    make_partial_fingerprint_fn,
     param_fingerprint,
     partial_fingerprints,
 )
@@ -55,6 +56,7 @@ __all__ = [
     "check_partial_desync",
     "gather_fingerprints",
     "gather_partial_fingerprints",
+    "make_partial_fingerprint_fn",
     "param_fingerprint",
     "partial_fingerprints",
     "global_norm",

@@ -62,6 +62,65 @@ def gather_fingerprints(fingerprint: float) -> np.ndarray:
     ).reshape(-1)
 
 
+def make_partial_fingerprint_fn(mesh, param_shardings=None):
+    """Compiled per-device partial checksums: the ``shard_map`` form of
+    :func:`partial_fingerprints` that never fetches a shard to the host.
+
+    Each device reduces the blocks it holds to ONE scalar inside the
+    program (no cross-device reduction anywhere); the output is the
+    ``(data, model)`` matrix laid out one scalar per device, so the only
+    device→host traffic per check is ``data × model`` values — the
+    multi-GB host fetch the original per-shard path paid each epoch
+    disappears.  ``param_shardings`` — a params-shaped tree of
+    ``NamedSharding``s naming the state's actual layout (``None`` =
+    fully replicated); passing the real layout keeps the shard_map from
+    inserting reshards.
+
+    The checksum is deliberately NOT the float abs-sum the host paths
+    use: a float32 accumulation over a large leaf can ROUND AWAY a
+    low-order-bit drift (the f64 host path keeps ~29 more bits; on the
+    pinned no-x64 jax there is no f64 on device), and a desync detector
+    that can miss single-bit flips is not a detector.  Instead each leaf
+    is bitcast to int32 and accumulated with WRAPPING int32 addition
+    under the same ``(i % 31) + 1`` position weight — exact modular
+    arithmetic, so ANY differing bit in any shard (including NaN-payload
+    differences the float path's abs() erases) changes the scalar.
+    In-sync replicas reduce identical blocks with identical programs, so
+    equal stays exactly equal; ``check_partial_desync``'s column
+    comparison needs only that.
+    """
+    from .._compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if param_shardings is None:
+        specs = None
+    else:
+        specs = jax.tree_util.tree_map(
+            lambda s: getattr(s, "spec", P()), param_shardings
+        )
+
+    def local(params):
+        total = jnp.zeros((), jnp.int32)
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
+            if leaf.dtype.itemsize != 4:
+                # exact widening (bf16/f16 → f32 is lossless) so every
+                # leaf bitcasts to one int32 per element
+                leaf = leaf.astype(jnp.float32)
+            bits = jax.lax.bitcast_convert_type(leaf, jnp.int32)
+            total = total + jnp.sum(bits, dtype=jnp.int32) * jnp.int32(
+                (i % 31) + 1
+            )
+        return total.reshape(1, 1)
+
+    in_specs = (specs if specs is not None else P(),)
+    return jax.jit(
+        shard_map(
+            local, mesh=mesh, in_specs=in_specs,
+            out_specs=P("data", "model"),
+        )
+    )
+
+
 def partial_fingerprints(params, mesh) -> np.ndarray:
     """Per-device partial checksums as a ``(data, model)`` float64 matrix,
     computed host-side over each leaf's **addressable** shards with NO

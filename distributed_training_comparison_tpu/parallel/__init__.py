@@ -42,6 +42,14 @@ from .tp import (
     param_partition_specs,
     state_shardings,
 )
+from .comms import (
+    Comms,
+    make_compressed_allreduce,
+    opt_state_bytes,
+    quantize_tree,
+    zero_opt_shardings,
+    zero_partition_spec,
+)
 from .dist import init_distributed, is_main_process, process_count, process_index
 from .ring import (
     make_ring_attention,
@@ -63,6 +71,12 @@ from .pipeline import (
 __all__ = [
     "make_mesh",
     "mesh_shape_for_backend",
+    "Comms",
+    "make_compressed_allreduce",
+    "opt_state_bytes",
+    "quantize_tree",
+    "zero_opt_shardings",
+    "zero_partition_spec",
     "batch_sharding",
     "replicated_sharding",
     "shard_batch",

@@ -122,6 +122,7 @@ def validate_reshard(
     *,
     batch_size: int,
     grad_accum: int = 1,
+    shard_optim: bool = False,
 ) -> dict:
     """The explicit reshard step of an elastic restore: validate the saved
     mesh against the re-rendered one and the global batch against the new
@@ -152,6 +153,12 @@ def validate_reshard(
         saved_mesh != now_shape
         or saved_devices not in (None, jax.device_count())
     )
+    # the comms-layout half of the reshard step: a checkpoint saved under
+    # --shard-optim restores onto a replicated layout (and vice versa) by
+    # plain re-placement — the host-pytree format carries no layout — but
+    # the delta is recorded so the restore log can say so.  Manifests from
+    # before the comms layer carry no key; treated as "unchanged".
+    saved_shard_optim = (manifest or {}).get("shard_optim")
     return {
         "changed": changed,
         "saved_mesh": saved_mesh,
@@ -161,6 +168,12 @@ def validate_reshard(
         "devices": jax.device_count(),
         "processes": jax.process_count(),
         "per_device_batch": batch_size // data_axis,
+        "saved_shard_optim": saved_shard_optim,
+        "shard_optim": bool(shard_optim),
+        "shard_optim_changed": (
+            saved_shard_optim is not None
+            and bool(saved_shard_optim) != bool(shard_optim)
+        ),
     }
 
 

@@ -115,6 +115,13 @@ def agreed_version_dir(ckpt_root: str | Path) -> Path:
 
 
 def _state_dict(state: TrainState) -> dict[str, Any]:
+    # comms_residual (the --grad-comms error-feedback carry) is
+    # deliberately excluded: checkpoints stay bit-compatible across every
+    # --shard-optim/--grad-comms combination, and a resumed run restarts
+    # the residual at zero (costs at most one step's quantization error).
+    # Sharded optimizer state needs nothing here either — fetch_to_host
+    # gathers full host arrays whatever the layout, and restore re-places
+    # them under the restoring run's shardings (the reshard step).
     return {
         "step": state.step,
         "params": state.params,

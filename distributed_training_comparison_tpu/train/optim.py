@@ -19,6 +19,18 @@ Semantics that must match for the accuracy target (SURVEY.md §7 risks):
 The schedule is part of the compiled update (a function of ``opt_state``'s
 step count), so LR changes never require retracing or host intervention —
 unlike the reference's host-side ``lr_scheduler.step()``.
+
+Sharding note (``parallel/comms.py`` ``--shard-optim``): this transform
+chain is ELEMENTWISE over parameters — decay couple, momentum trace, and
+schedule scale never mix values across parameters or across elements of
+one parameter — which is what makes the ZeRO cross-replica sharded update
+exact: a per-shard optimizer step over a data-sharded gradient computes
+the same values the replicated step would, so sharding is purely a layout
+choice (pinned at ~1 ulp by ``tests/test_comms.py``).  A future
+non-elementwise transform (cross-leaf global-norm clipping, LAMB trust
+ratios) stays *correct* under GSPMD — XLA inserts the cross-shard
+reductions the math needs — but turns the free layout change into real
+collectives; price it against the compile ledger before defaulting it.
 """
 
 from __future__ import annotations

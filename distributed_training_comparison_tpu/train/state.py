@@ -18,7 +18,18 @@ from flax import core, struct
 
 
 class TrainState(struct.PyTreeNode):
-    """Minimal SPMD train state (flax ``train_state.TrainState`` + BN stats)."""
+    """Minimal SPMD train state (flax ``train_state.TrainState`` + BN stats).
+
+    ``comms_residual`` is the compressed-gradient-sync error-feedback
+    residual (``parallel/comms.py``): a params-shaped fp32 tree under
+    ``--grad-comms fp16/int8``, ``None`` otherwise.  ``None`` is an empty
+    pytree node, so the default state flattens to exactly the same leaves
+    as before the field existed — the benign path's executables (and their
+    compile-event fingerprints) are unchanged.  The residual is
+    deliberately NOT checkpointed (``checkpoint._state_dict``): a resumed
+    run restarts it at zero, costing at most one step's quantization
+    error.
+    """
 
     step: jax.Array
     params: core.FrozenDict[str, Any]
@@ -26,6 +37,7 @@ class TrainState(struct.PyTreeNode):
     opt_state: optax.OptState
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    comms_residual: Any = None
 
     def apply_gradients(self, *, grads, batch_stats) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)

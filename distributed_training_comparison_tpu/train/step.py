@@ -139,6 +139,7 @@ def _make_step_core(
     grad_accum: int = 1,
     accum_sharding=None,
     fwd_bwd=None,
+    comms=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """The shared train core: augment → normalize → fwd/bwd → SGD update.
 
@@ -172,7 +173,18 @@ def _make_step_core(
     both the loss metric and the gradients — NaN/Inf scales exercise the
     guard, large finite scales exercise the spike detector — and costs
     nothing when absent (the default ``None`` traces no fault ops at all).
+
+    ``comms`` — the run's communications plan (``parallel/comms.py``):
+    when active it replaces the plain ``apply_gradients`` epilogue with
+    the ZeRO-sharded / compressed update (reduce-scatter → per-shard
+    optimizer step → all-gather; quantize with error feedback).  The
+    numerics guards are unchanged either way — ``grad_norm``/``finite``
+    are computed on the RAW gradients, before any compression, and a
+    non-finite step still keeps the entire old state (residual included).
+    ``None`` or an inactive plan traces exactly the pre-comms update, so
+    the benign path's executable is byte-identical.
     """
+    comms_active = comms is not None and comms.active
     compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
     def forward_backward(params, apply_fn, batch_stats, images, labels, key):
@@ -276,9 +288,14 @@ def _make_step_core(
         # state (the skipped update costs one batch, never a poisoned run)
         grad_norm = global_norm(grads)
         finite = step_finite(loss, grad_norm)
-        new_state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+        if comms_active:
+            new_state = comms.apply_gradients(
+                state, grads=grads, batch_stats=new_stats
+            )
+        else:
+            new_state = state.apply_gradients(grads=grads, batch_stats=new_stats)
         state = select_tree(finite, new_state, state)
-        return state, {
+        metrics = {
             "loss": loss,
             "top1_count": top1_count,
             "count": labels.size,
@@ -286,6 +303,13 @@ def _make_step_core(
             "skipped": 1.0 - finite.astype(jnp.float32),
             **extras,
         }
+        if comms_active and comms.compressing and state.comms_residual is not None:
+            # compression health: the error-feedback residual's global norm
+            # rides the stacked fetch like the guard metrics (zero extra
+            # host syncs); a residual norm growing without bound means the
+            # wire is too narrow for this gradient distribution
+            metrics["comms_err"] = global_norm(state.comms_residual)
+        return state, metrics
 
     return core
 
@@ -300,6 +324,7 @@ def make_train_step(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    comms=None,
     monitor=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """Build the compiled ``(state, images_u8, labels, key) -> (state, metrics)``.
@@ -316,7 +341,9 @@ def make_train_step(
     accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd, comms
+    )
 
     # No buffer donation here: this per-step path serves benchmarks and
     # tests that re-read their inputs after the call (the scanned runners
@@ -455,6 +482,7 @@ def make_chunk_runner(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    comms=None,
     fault_injection: bool = False,
     donate: bool = True,
     monitor=None,
@@ -489,7 +517,9 @@ def make_chunk_runner(
     chunk_shard = batch_sharding(mesh, axis=1)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum, chunk_shard, fwd_bwd)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, chunk_shard, fwd_bwd, comms
+    )
 
     def _run(state: TrainState, images, labels, epoch_key: jax.Array, start, fault):
         def body(state, inp):
@@ -540,6 +570,7 @@ def make_device_chunk_runner(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    comms=None,
     fault_injection: bool = False,
     donate: bool = True,
     monitor=None,
@@ -570,7 +601,9 @@ def make_device_chunk_runner(
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     accum_shard = batch_sharding(mesh, axis=1)
-    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd, comms
+    )
 
     def _run(state: TrainState, images, labels, key: jax.Array, epoch, start, fault):
         n = images.shape[0]
@@ -630,6 +663,7 @@ def make_epoch_runner(
     state_sharding=None,
     grad_accum: int = 1,
     fwd_bwd=None,
+    comms=None,
     fault_injection: bool = False,
     donate: bool = True,
     monitor=None,
@@ -658,7 +692,9 @@ def make_epoch_runner(
     accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
-    core = _make_step_core(precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd)
+    core = _make_step_core(
+        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd, comms
+    )
 
     def _run(state: TrainState, images, labels, key: jax.Array, epoch, fault):
         n = images.shape[0]

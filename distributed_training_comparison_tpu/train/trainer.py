@@ -58,6 +58,7 @@ from ..data.cifar100 import CIFAR100_MEAN, CIFAR100_STD, IMAGENET_MEAN, IMAGENET
 from ..health import HealthConfig, Watchdog, check_desync, param_fingerprint, write_health
 from ..models import get_model
 from ..parallel import is_main_process, make_mesh, state_shardings
+from ..parallel import comms as comms_mod
 from ..parallel.sharding import (
     fetch_to_host,
     host_local_batch_slice,
@@ -343,6 +344,54 @@ class Trainer:
             )
         else:
             self.state_sharding = state_shardings(self.mesh, state)
+        # --- comms layer (parallel/comms.py): ZeRO-style sharded weight
+        # update (--shard-optim) + compressed gradient sync (--grad-comms).
+        # Both off (the default) leaves self.comms inactive and the traced
+        # update — and therefore every executable fingerprint — unchanged.
+        self.shard_optim = bool(getattr(hparams, "shard_optim", False))
+        self.grad_comms = getattr(hparams, "grad_comms", "fp32") or "fp32"
+        self.comms = None
+        if self.shard_optim or self.grad_comms != "fp32":
+            self.comms = comms_mod.Comms(
+                self.mesh,
+                param_shardings=self.state_sharding.params,
+                shard_optim=self.shard_optim,
+                grad_comms=self.grad_comms,
+            )
+            if self.grad_comms != "fp32":
+                # error-feedback residual: params-shaped fp32, carried in
+                # the train state (laid out like the params), NOT
+                # checkpointed — a resume restarts it at zero
+                state = state.replace(
+                    comms_residual=self.comms.residual_init(state.params)
+                )
+                self.state_sharding = self.state_sharding.replace(
+                    comms_residual=self.state_sharding.params
+                )
+            if self.shard_optim:
+                # the whole re-layout: the optimizer state is CARRIED
+                # data-sharded between dispatches (per-device opt-state HBM
+                # ~1/N — the compile-event ledger shows it as smaller
+                # argument bytes); the update's reduce-scatter/all-gather
+                # constraints live in Comms.apply_gradients
+                self.state_sharding = self.state_sharding.replace(
+                    opt_state=comms_mod.zero_opt_shardings(
+                        self.mesh, state.opt_state,
+                        self.state_sharding.opt_state,
+                    )
+                )
+            # static comms gauges (wire width, sync bytes, opt-state
+            # footprint total vs per-device) ride the registry like every
+            # other plane — flushes, exporter, alert rules.  The per-device
+            # arithmetic prices the sharding tree the run ACTUALLY carries
+            # (installed just above), not a re-derivation.
+            for k, v in self.comms.summary(
+                state.params, state.opt_state,
+                opt_shardings=(
+                    self.state_sharding.opt_state if self.shard_optim else None
+                ),
+            ).items():
+                self.metrics.gauge(f"comms/{k}").set(v)
         self.state = place_tree(state, self.state_sharding)
 
         # --- compiled programs
@@ -374,6 +423,7 @@ class Trainer:
                 state_sharding=self.state_sharding,
                 grad_accum=self.grad_accum,
                 fwd_bwd=self.train_fwd_bwd,
+                comms=self.comms,
                 fault_injection=self._step_faults,
                 monitor=self.compile_monitor,
             )
@@ -521,6 +571,7 @@ class Trainer:
                 hparams.resume, self.state, raw_bytes=resume_bytes
             )
             resume_bytes = None  # drop the (possibly GB-sized) buffer now
+            state = self._reset_comms_residual(state)
             # from_state_dict returns host numpy leaves; re-place them as
             # global mesh arrays with the run's layout (jit on a multi-host
             # mesh requires global jax.Arrays, not host buffers).  The
@@ -543,7 +594,18 @@ class Trainer:
             self._reshard = elastic.validate_reshard(
                 manifest, self.mesh,
                 batch_size=hparams.batch_size, grad_accum=self.grad_accum,
+                shard_optim=self.shard_optim,
             )
+            if self._reshard.get("shard_optim_changed"):
+                # checkpoints are host pytrees, so crossing --shard-optim
+                # on↔off is just a different place_tree layout — noted so
+                # the restore log explains the relaid optimizer state
+                self.logger.info(
+                    "comms reshard: checkpoint saved with shard_optim="
+                    f"{self._reshard['saved_shard_optim']} → restoring "
+                    f"with shard_optim={self.shard_optim} (optimizer "
+                    "state re-laid out; values unchanged)"
+                )
             elastic_msg = elastic.describe_restore(manifest, self.mesh)
             if elastic_msg:
                 self.logger.info(elastic_msg)
@@ -588,6 +650,9 @@ class Trainer:
                 bus=self.bus,
             )
         self._fingerprint_fn = None  # jitted lazily on first desync check
+        # per-device partial-reduce desync path (model_parallel > 1):
+        # compiled lazily; False = permanently degraded to the host fetch
+        self._partial_fp_fn = None
         self._epoch_health: dict = {}
         self._epoch_step_base = 0  # first global-within-epoch step trained
         # step-time breakdown (h2d-wait / dispatch / compute): per-epoch
@@ -616,6 +681,8 @@ class Trainer:
             precision=self.precision,
             resumed=bool(getattr(hparams, "resume", None)),
             resharded=bool(self._reshard and self._reshard["changed"]),
+            shard_optim=self.shard_optim,
+            grad_comms=self.grad_comms,
             resume_step_offset=self._resume_step_offset,
             init_s=round(self._init_secs, 4),
         )
@@ -729,6 +796,33 @@ class Trainer:
             self.resources.sample(self.metrics)
             self.metrics.maybe_flush(self.bus, epoch=epoch, step=step)
 
+    @staticmethod
+    def _ckpt_view(state):
+        """The state as every checkpoint path consumes it: without the
+        comms error-feedback residual.  ``_state_dict`` never serializes
+        the residual, so fetching/snapshotting it would pay a
+        params-sized device→host gather (or HBM copy) per save for bytes
+        that are discarded."""
+        if state.comms_residual is None:
+            return state
+        return state.replace(comms_residual=None)
+
+    @staticmethod
+    def _reset_comms_residual(state):
+        """Restart the compressed-sync error-feedback residual at zero
+        (resume and rollback both land here: the residual is never
+        checkpointed, and a rolled-back residual belonged to the
+        discarded trajectory).  HOST zeros, deliberately — both callers
+        feed ``place_tree``, whose multi-host branch cannot re-place a
+        live partitioned device leaf."""
+        if state.comms_residual is None:
+            return state
+        return state.replace(
+            comms_residual=jax.tree_util.tree_map(
+                lambda l: np.zeros(l.shape, l.dtype), state.params
+            )
+        )
+
     def _ckpt_meta(self) -> dict:
         """Manifest metadata every resumable save carries: the saving mesh
         topology (elastic-restore accounting) plus the run identity, so a
@@ -744,6 +838,14 @@ class Trainer:
             "run_id": self.bus.run_id,
             "attempt": self.bus.attempt,
         }
+        # the comms layout the checkpoint was saved under — recorded
+        # UNCONDITIONALLY (a comms-off manifest must be distinguishable
+        # from a pre-comms-layer one, or the off→on restore would never
+        # report its re-layout); restore is a plain host-pytree
+        # re-placement either way (the reshard step), validate_reshard
+        # records the delta for the log
+        meta["shard_optim"] = self.shard_optim
+        meta["grad_comms"] = self.grad_comms
         quarantined = getattr(self.train_loader, "quarantined", None)
         if quarantined:
             meta["quarantined"] = sorted(quarantined)
@@ -808,6 +910,7 @@ class Trainer:
                 state_sharding=self.state_sharding,
                 grad_accum=self.grad_accum,
                 fwd_bwd=self.train_fwd_bwd,
+                comms=self.comms,
                 fault_injection=self._step_faults,
                 monitor=self.compile_monitor,
             )
@@ -957,7 +1060,11 @@ class Trainer:
             # Checkpoint decisions are computed on EVERY process from
             # replicated values (val metrics are identical across hosts) so
             # that the collective-fetch path below runs symmetrically.
-            state_ref, vdir = self.state, self.version_dir
+            # The comms error-feedback residual is dropped up front: no
+            # save path serializes it (checkpoint._state_dict), so fetching
+            # or snapshotting it would move a params-sized tree per save
+            # for data that is thrown away.
+            state_ref, vdir = self._ckpt_view(self.state), self.version_dir
             want_best = val["val_acc"] > self.best_acc
             if want_best:
                 self.best_acc = val["val_acc"]
@@ -1245,20 +1352,88 @@ class Trainer:
             float(self._fingerprint_fn(self.state.params)), inject=inject
         )
         if self.mesh.shape["model"] > 1 and not report["mismatch"]:
-            from ..health import (
-                check_partial_desync,
-                gather_partial_fingerprints,
-                partial_fingerprints,
-            )
+            from ..health import check_partial_desync
 
-            partial = check_partial_desync(
-                gather_partial_fingerprints(
-                    partial_fingerprints(self.state.params, self.mesh)
-                )
-            )
+            partial = check_partial_desync(self._partial_matrix())
             if partial["mismatch"]:
                 report = {**partial, "injected": inject}
         return report
+
+    def _partial_matrix(self) -> np.ndarray:
+        """The per-device ``(data, model)`` partial-fingerprint matrix.
+
+        Preferred path: the compiled shard_map reduce
+        (``health.make_partial_fingerprint_fn``) — each device folds its
+        own shards to one scalar IN the program, so the device→host
+        traffic per check is ``data × model`` floats instead of the full
+        local shard set (multi-GB states paid that fetch every epoch).
+        Any failure degrades permanently to the original host-side path;
+        desync detection must never die with its optimization.
+
+        The degrade decision is FLEET-SYMMETRIC: both branches end in a
+        collective under multi-host (the device path's partitioned fetch,
+        the host path's allgather), so one host silently falling back
+        while its peers stay on the device path would put the processes
+        in mismatched collectives and wedge the fleet.  Every process
+        therefore reports its local build/dispatch success and the fleet
+        takes the path ONLY if every process can (one tiny allgather per
+        check — noise next to the fingerprint collectives this method
+        already runs).
+        """
+        from ..health import (
+            gather_partial_fingerprints,
+            make_partial_fingerprint_fn,
+            partial_fingerprints,
+        )
+
+        if self._partial_fp_fn is None:
+            try:
+                self._partial_fp_fn = self.compile_monitor.instrument(
+                    make_partial_fingerprint_fn(
+                        self.mesh, self.state_sharding.params
+                    ),
+                    "partial_fingerprint", sentinel=False,
+                )
+            except Exception as e:
+                self.logger.warning(
+                    f"health: per-device partial-fingerprint reduce "
+                    f"unavailable ({e}); falling back to the host fetch"
+                )
+                self._partial_fp_fn = False
+        result = None
+        if self._partial_fp_fn:
+            try:
+                # dispatch only — the (collective-bearing) fetch waits
+                # until every process has agreed the dispatch succeeded
+                result = self._partial_fp_fn(self.state.params)
+            except Exception as e:
+                self.logger.warning(
+                    f"health: per-device partial-fingerprint reduce failed "
+                    f"({e}); falling back to the host fetch"
+                )
+                self._partial_fp_fn = False
+        ok = result is not None
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            ok = bool(
+                np.all(multihost_utils.process_allgather(np.asarray(ok)))
+            )
+            if not ok and self._partial_fp_fn:
+                # a PEER degraded: follow it permanently so every later
+                # check re-agrees trivially instead of re-paying a doomed
+                # dispatch per epoch
+                self.logger.warning(
+                    "health: a peer process degraded the per-device "
+                    "partial-fingerprint reduce; following to the host "
+                    "fetch fleet-wide"
+                )
+                self._partial_fp_fn = False
+        if ok:
+            return np.asarray(fetch_to_host(result))
+        return gather_partial_fingerprints(
+            partial_fingerprints(self.state.params, self.mesh)
+        )
 
     def _rollback(
         self, epoch: int, epoch_time: float, reason: str, verdict=None
@@ -1348,6 +1523,7 @@ class Trainer:
             state, next_epoch, best = ckpt.load_resume_state(
                 path, self.state, raw_bytes=data
             )
+        state = self._reset_comms_residual(state)
         self.state = place_tree(state, self.state_sharding)
         self.best_acc = best
         # corrupt-shard quarantine (--health-quarantine, host data mode):
@@ -1510,7 +1686,7 @@ class Trainer:
             "preempt", epoch=epoch,
             step=epoch * self.steps_per_epoch + steps_done, mid_epoch=True,
         )
-        state_ref = self.state
+        state_ref = self._ckpt_view(self.state)
         sync_fetch = jax.process_count() > 1 and needs_collective_fetch(state_ref)
         if getattr(self.hparams, "save_last", True):
             if sync_fetch:
@@ -1704,7 +1880,7 @@ class Trainer:
         the tunneled bench host).  This fetch is also where the main thread
         finally blocks on the device, so it is the ``compute`` leg of the
         step-time breakdown."""
-        keep = ("loss", "top1_count", "skipped", "grad_norm")
+        keep = ("loss", "top1_count", "skipped", "grad_norm", "comms_err")
         with self._step_meter.phase("compute"):
             fetched = jax.device_get(
                 [
@@ -1717,6 +1893,13 @@ class Trainer:
                 ]
             )
         losses = np.concatenate([np.asarray(m["loss"]) for m in fetched])
+        if "comms_err" in fetched[0]:
+            # compressed-sync health: per-step error-feedback residual norm
+            # (one sketch per flush; p99 growing epoch over epoch means the
+            # wire precision is too narrow for this gradient distribution)
+            self.metrics.histogram("comms/residual_norm").record_many(
+                np.concatenate([np.asarray(m["comms_err"]) for m in fetched])
+            )
         top1 = float(sum(np.asarray(m["top1_count"]).sum() for m in fetched))
         # stashed for fit()'s TB/log/health pass rather than widening the return
         self._epoch_health = {

@@ -920,32 +920,50 @@ def compute_summary(events: list[dict], peak_override: float | None = None) -> d
     totals = {
         "executables": len(rows), "compiles": 0, "compile_s": 0.0,
         "cache_hits": 0, "cache_misses": 0, "recompiles_after_warmup": 0,
-        "flops_dispatched": 0.0, "dispatch_s": 0.0,
+        "flops_dispatched": 0.0, "dispatch_s": 0.0, "drain_s": 0.0,
     }
-    mfu_num = mfu_den = 0.0
     for row in rows.values():
         sketch = merged.get(f"exec/{row['name']}:{row['fingerprint'][:8]}/dispatch_s")
         row["dispatches"] = int((sketch or {}).get("count", 0))
         row["dispatch_s"] = float((sketch or {}).get("sum", 0.0))
+    # Drain fold: the epoch's FINAL chunk executes while the main thread
+    # blocks in the metrics fetch — that device time lands in the
+    # `step/compute_s` span, not in any dispatch span, so dividing flops
+    # by dispatch-span seconds alone UNDERcounts the denominator and
+    # overstates MFU.  Fold the compute-span seconds into the dispatch
+    # seconds pro-rata by each executable's dispatch share (the drain
+    # belongs to whichever programs were in flight, and dispatch share is
+    # the best stream-reconstructable proxy).
+    drain_total = float((merged.get("step/compute_s") or {}).get("sum", 0.0))
+    dispatch_total = sum(r["dispatch_s"] for r in rows.values())
+    totals["drain_s"] = drain_total
+    mfu_num = mfu_den = 0.0
+    for row in rows.values():
+        row["drain_s"] = (
+            drain_total * row["dispatch_s"] / dispatch_total
+            if dispatch_total > 0
+            else 0.0
+        )
         peak = (
             peak_override
             if peak_override
             else peak_flops_for(row["device_kind"])
         )
         row["mfu"] = None
+        span_s = row["dispatch_s"] + row["drain_s"]
         if (
             peak
             and row["flops"]
             and row["dispatches"]
-            and row["dispatch_s"] > 0
+            and span_s > 0
         ):
             devices = row["devices"] or 1
             row["mfu"] = (
                 row["flops"] * row["dispatches"]
-                / row["dispatch_s"] / (peak * devices)
+                / span_s / (peak * devices)
             )
             mfu_num += row["flops"] * row["dispatches"]
-            mfu_den += row["dispatch_s"] * peak * devices
+            mfu_den += span_s * peak * devices
         totals["compiles"] += row["compiles"]
         totals["compile_s"] += row["compile_s"]
         totals["cache_hits"] += row["cache_hits"]
@@ -1016,6 +1034,12 @@ def format_compute(comp: dict) -> str:
             f"  * {t['recompiles_after_warmup']} executable(s) compiled "
             "AFTER warmup — the recompilation sentinel's findings "
             "(serve bucket churn / unexpected reshape)"
+        )
+    if t.get("drain_s"):
+        lines.append(
+            f"  compute-span drain folded into MFU denominators: "
+            f"{t['drain_s']:.4f}s (pro-rata by dispatch share — the "
+            "epoch-final chunk executes inside the metrics fetch)"
         )
     if t.get("mfu") is not None:
         lines.append(
