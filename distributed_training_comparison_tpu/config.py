@@ -133,11 +133,36 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "trunk with ring attention / Ulysses all-to-all (vit_* models only)",
     )
     parser.add_argument(
+        "--pipeline-parallel",
+        type=int,
+        default=1,
+        help="Pipeline-parallel degree on the DEDICATED 'pipe' mesh axis "
+        "(parallel/mesh.py): the stacked transformer trunk is staged "
+        "across P pipeline stages, COMPOSABLE with --model-parallel "
+        "tensor parallelism (DP x TP x PP — the trunk shards (pipe on "
+        "the depth axis, model on the feature dims), so model size "
+        "scales past one TP group's HBM). Requires a vit_* model and "
+        "--parallel-style tensor (the model axis keeps its meaning). "
+        "1 = off. --parallel-style pipeline remains the legacy "
+        "single-axis spelling (pipe schedule on the model axis, no TP)",
+    )
+    parser.add_argument(
         "--pipeline-microbatches",
         type=int,
         default=0,
-        help="Microbatches per step for --parallel-style pipeline "
+        help="Microbatches per step for pipeline parallelism "
         "(0 = auto: 4x the stage count; bubble fraction (P-1)/(M+P-1))",
+    )
+    parser.add_argument(
+        "--pipeline-virtual-stages",
+        type=int,
+        default=0,
+        help="Virtual stages per device for --pipeline-schedule "
+        "interleaved (each device owns v NON-contiguous layer chunks; "
+        "per-tick work shrinks v-fold so the warmup/cooldown bubble "
+        "shrinks toward ((v+1)P-2)/(vM+(v+1)P-2) at the same microbatch "
+        "count). 0 = auto: 2 for the interleaved schedule, 1 otherwise. "
+        "Requires depth %% (P*v) == 0 and microbatches %% P == 0",
     )
     parser.add_argument(
         "--patch-size",
@@ -192,12 +217,15 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--pipeline-schedule",
         type=str,
         default="gpipe",
-        choices=["gpipe", "1f1b"],
+        choices=["gpipe", "1f1b", "interleaved"],
         help="Pipeline schedule: 'gpipe' = all forwards then all backwards "
         "(autodiff reverse; O(M) stashed microbatches per stage); '1f1b' = "
         "one-forward-one-backward with per-stage activation recompute "
         "(same bubble, O(P) stashed microbatches — the memory headroom "
-        "that lets M grow)",
+        "that lets M grow); 'interleaved' = 1F1B over v virtual stages "
+        "per device (--pipeline-virtual-stages): non-contiguous layer "
+        "chunks cut the warmup/cooldown bubble ~v-fold at the same "
+        "microbatch count, same O(P) stash",
     )
     parser.add_argument(
         "--precision",
@@ -787,6 +815,27 @@ def load_config(
         )
     if args.restart_backoff < 0:
         parser.error(f"--restart-backoff must be >= 0, got {args.restart_backoff}")
+    if args.pipeline_parallel < 1:
+        parser.error(
+            f"--pipeline-parallel must be >= 1, got {args.pipeline_parallel}"
+        )
+    if args.pipeline_virtual_stages < 0:
+        parser.error(
+            f"--pipeline-virtual-stages must be >= 0, got "
+            f"{args.pipeline_virtual_stages}"
+        )
+    if args.pipeline_virtual_stages > 1 and args.pipeline_schedule != "interleaved":
+        parser.error(
+            "--pipeline-virtual-stages > 1 needs --pipeline-schedule "
+            "interleaved (gpipe/1f1b schedule one contiguous slice per stage)"
+        )
+    if args.pipeline_parallel > 1 and args.parallel_style != "tensor":
+        parser.error(
+            "--pipeline-parallel composes with --parallel-style tensor "
+            "(the model axis keeps its tensor-parallel meaning; "
+            "--parallel-style pipeline is the legacy single-axis spelling "
+            "— use one or the other)"
+        )
     if args.fleet_hosts < 0:
         parser.error(f"--fleet-hosts must be >= 0, got {args.fleet_hosts}")
     if args.fleet_hosts > 1 and not args.supervise:

@@ -33,17 +33,38 @@ import jax.numpy as jnp
 from .cifar100 import CIFAR100_MEAN, CIFAR100_STD
 
 
-@partial(jax.jit, static_argnames=("padding",))
-def random_crop_flip(images: jnp.ndarray, key: jax.Array, padding: int = 4) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("padding", "draw_sharding"))
+def random_crop_flip(
+    images: jnp.ndarray,
+    key: jax.Array,
+    padding: int = 4,
+    *,
+    draw_sharding=None,
+) -> jnp.ndarray:
     """Pad-`padding` random crop + horizontal flip over a whole NHWC batch.
 
     ``images`` may be uint8 or float; dtype is preserved.  One key per call;
     per-sample randomness is split internally.
+
+    ``draw_sharding`` — a replicated ``NamedSharding`` pinning the random
+    DRAWS (offsets/flips).  Required for bit-reproducibility whenever the
+    batch is sharded on a mesh with more than one axis: on the pinned jax
+    (``jax_threefry_partitionable`` off) GSPMD may partition the threefry
+    bit generation differently per mesh shape, silently changing which
+    crop/flip each example draws — the same (seed, epoch, step) then
+    augments differently under DP than under DP×TP×PP, breaking the
+    cross-layout trajectory-parity contract this module's docstring
+    promises.  The constraint forces the (tiny) generation replicated, so
+    every layout draws exactly the single-device stream.  ``None`` keeps
+    the pre-pipeline behavior (eager/test callers without a mesh).
     """
     b, h, w, _ = images.shape
     crop_key, flip_key = jax.random.split(key)
     offsets = jax.random.randint(crop_key, (b, 2), 0, 2 * padding + 1)
     flips = jax.random.bernoulli(flip_key, 0.5, (b,))
+    if draw_sharding is not None:
+        offsets = jax.lax.with_sharding_constraint(offsets, draw_sharding)
+        flips = jax.lax.with_sharding_constraint(flips, draw_sharding)
 
     padded = jnp.pad(
         images,

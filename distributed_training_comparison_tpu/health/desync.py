@@ -110,30 +110,36 @@ def make_partial_fingerprint_fn(mesh, param_shardings=None):
             total = total + jnp.sum(bits, dtype=jnp.int32) * jnp.int32(
                 (i % 31) + 1
             )
-        return total.reshape(1, 1)
+        return total
+
+    axis_names = tuple(mesh.axis_names)
+
+    def local_nd(params):
+        return local(params).reshape((1,) * len(axis_names))
 
     in_specs = (specs if specs is not None else P(),)
     return jax.jit(
         shard_map(
-            local, mesh=mesh, in_specs=in_specs,
-            out_specs=P("data", "model"),
+            local_nd, mesh=mesh, in_specs=in_specs,
+            out_specs=P(*axis_names),
         )
     )
 
 
 def partial_fingerprints(params, mesh) -> np.ndarray:
-    """Per-device partial checksums as a ``(data, model)`` float64 matrix,
-    computed host-side over each leaf's **addressable** shards with NO
-    cross-device reduction — the same position-weighted per-leaf abs-sum as
-    ``param_fingerprint``, but kept per device so drift inside a sharded
-    leaf stays visible.  Devices this process does not own contribute 0;
-    summing the allgathered matrices across processes (each device is owned
-    by exactly one) rebuilds the full fleet view —
-    ``gather_partial_fingerprints`` does that."""
-    shape = (mesh.shape["data"], mesh.shape["model"])
+    """Per-device partial checksums as a float64 matrix shaped like the
+    mesh (``(data, model)`` on two-axis meshes, ``(data, model, pipe)``
+    with the pipeline axis), computed host-side over each leaf's
+    **addressable** shards with NO cross-device reduction — the same
+    position-weighted per-leaf abs-sum as ``param_fingerprint``, but kept
+    per device so drift inside a sharded leaf stays visible.  Devices this
+    process does not own contribute 0; summing the allgathered matrices
+    across processes (each device is owned by exactly one) rebuilds the
+    full fleet view — ``gather_partial_fingerprints`` does that."""
+    shape = tuple(mesh.shape[a] for a in mesh.axis_names)
     coords = {
-        dev.id: (d, m)
-        for (d, m), dev in np.ndenumerate(mesh.devices)
+        dev.id: pos
+        for pos, dev in np.ndenumerate(mesh.devices)
     }
     out = np.zeros(shape, np.float64)
     for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
@@ -163,30 +169,40 @@ def gather_partial_fingerprints(local: np.ndarray) -> np.ndarray:
 
 
 def check_partial_desync(matrix: np.ndarray, *, inject: bool = False) -> dict:
-    """Judge a ``(data, model)`` partial-fingerprint matrix: params are
-    replicated across the data axis, so every model column must be
-    constant down it.  Any spread is per-replica drift inside that model
-    shard — the case the post-collective scalar check cannot see.
+    """Judge a partial-fingerprint matrix (``(data, model)`` or the full
+    ``(data, model, pipe)`` cube): params are replicated across the data
+    axis, so every (model[, pipe]) column must be constant down it.  Any
+    spread is per-replica drift inside that shard — the case the
+    post-collective scalar check cannot see.  With a pipe axis present the
+    report also carries ``per_stage_spread``: the worst column spread per
+    pipeline stage, so the desync verdict NAMES the drifted stage.
 
     ``inject=True`` perturbs the last data row (the fault-plan seam, like
     ``check_desync``), so CI drives the detect path deterministically.
     """
     m = np.asarray(matrix, np.float64)
-    if m.ndim != 2 or m.size == 0:
+    if m.ndim < 2 or m.size == 0:
         return {"mismatch": False, "spread": 0.0, "partial": True,
                 "injected": bool(inject)}
     if inject:
         m = m.copy()
-        m[-1, :] += np.maximum(1.0, np.abs(m[-1, :]) * 1e-3)
-    per_column = m.max(axis=0) - m.min(axis=0)
+        m[-1, ...] += np.maximum(1.0, np.abs(m[-1, ...]) * 1e-3)
+    flat = m.reshape(m.shape[0], -1)  # columns = (model[, pipe]) cells
+    per_column = flat.max(axis=0) - flat.min(axis=0)
     spread = float(per_column.max())
-    return {
+    report = {
         "mismatch": bool(spread != 0.0),
         "spread": spread,
         "per_model_spread": [float(x) for x in per_column],
         "partial": True,
         "injected": bool(inject),
     }
+    if m.ndim == 3 and m.shape[2] > 1:
+        cube = per_column.reshape(m.shape[1], m.shape[2])
+        report["per_stage_spread"] = [
+            float(cube[:, p].max()) for p in range(m.shape[2])
+        ]
+    return report
 
 
 def check_desync(fingerprint: float, *, inject: bool = False) -> dict:

@@ -78,6 +78,11 @@ KNOWN_KINDS = {
     # compiler observability (obs/compilation): one event per executable
     # built, carrying the HLO cost/memory analysis + cache outcome
     "compile",
+    # pipeline parallelism (parallel/pipeline): one event per attempt with
+    # the schedule's static tick arithmetic (ticks, useful ticks, bubble
+    # fraction, virtual stages) — run_report joins it with the measured
+    # dispatch sketches into the per-executable bubble table
+    "pipeline",
 }
 
 

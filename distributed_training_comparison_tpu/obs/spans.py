@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import zlib
 from contextlib import contextmanager, nullcontext
 from pathlib import Path
 
@@ -92,6 +93,40 @@ class SpanRecorder:
                     self._spans.append(rec)
                 else:
                     self._dropped += 1
+
+    def record(
+        self, name: str, t0: float, t1: float, *, lane: str | None = None,
+        **attrs,
+    ) -> None:
+        """Append an externally-timed span (``time.monotonic`` values,
+        same clock as :meth:`span`).  ``lane`` names a SYNTHETIC timeline
+        lane — a stable pseudo thread id derived from the lane name — so
+        derived timelines (the pipeline's per-(host, stage) lanes, where
+        one dispatch interval is subdivided by the schedule's tick
+        structure) render as their own Perfetto rows instead of
+        interleaving with the recording thread's real spans."""
+        if lane is None:
+            thread = threading.current_thread()
+            tid, tname = thread.ident, thread.name
+        else:
+            # high bit keeps pseudo-ids clear of real thread idents
+            tid = 0x5A000000 | (zlib.crc32(str(lane).encode()) & 0xFFFFFF)
+            tname = str(lane)
+        rec = {
+            "name": str(name),
+            "t0": float(t0),
+            "t1": float(t1),
+            "thread_id": tid,
+            "thread_name": tname,
+            "depth": 0,
+        }
+        if attrs:
+            rec["args"] = attrs
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(rec)
+            else:
+                self._dropped += 1
 
     def spans(self) -> list[dict]:
         with self._lock:

@@ -25,6 +25,7 @@ attribution needs a fleet and a distribution, not a guess.
 
 from __future__ import annotations
 
+import re
 from collections import defaultdict
 
 from .metrics import histogram_quantile, merge_histograms
@@ -41,6 +42,32 @@ STRAGGLER_KIND = "straggler"
 # recompile cliff reads as a straggler for the rest of the attempt.
 STEP_PHASES = ("h2d_wait", "dispatch", "compute")
 PHASE_METRICS = {f"step/{p}_s": p for p in STEP_PHASES}
+
+# Pipeline runs additionally flush one busy-seconds sketch per LOCAL
+# pipeline stage (`step/stage{s}/busy_s`, trainer._note_pipeline_obs) —
+# the stage dimension of straggler attribution: on a pod where each host
+# owns a stage, a finding names WHICH stage lags, not just which host.
+_STAGE_METRIC_RE = re.compile(r"^step/stage(\d+)/busy_s$")
+
+
+def _phase_of(metric_name: str) -> str | None:
+    """The straggler phase key for a metric name: one of ``STEP_PHASES``,
+    a per-pipeline-stage ``stage{s}`` key, or None (not a phase sketch)."""
+    phase = PHASE_METRICS.get(metric_name)
+    if phase is not None:
+        return phase
+    m = _STAGE_METRIC_RE.match(metric_name)
+    return f"stage{m.group(1)}" if m else None
+
+
+def _phase_columns(phases) -> list[str]:
+    """Render order: the host phases first, stage keys numerically."""
+    base = [p for p in STEP_PHASES if p in phases]
+    stages = sorted(
+        (p for p in phases if p.startswith("stage")),
+        key=lambda p: int(p[5:]),
+    )
+    return base + stages
 
 # same robustness idea as health/spike.py, tuned for timing data: chunk
 # wall-times are noisier than losses, so the MAD floor is a larger
@@ -76,7 +103,7 @@ def merge_phase_sketches(events) -> dict[tuple[int, int], dict[str, dict]]:
         key = (int(ev.get("attempt", 0)), int(ev.get("process_index", 0)))
         metrics = (ev.get("payload") or {}).get("metrics") or {}
         for name, snap in metrics.items():
-            phase = PHASE_METRICS.get(name)
+            phase = _phase_of(name)
             if phase is None or not isinstance(snap, dict):
                 continue
             out[key][phase] = merge_histograms(out[key].get(phase), snap)
@@ -141,18 +168,20 @@ def straggler_findings(
                 score, fleet = _score(p95, baseline)
                 if score < threshold_mads:
                     continue
-                findings.append(
-                    {
-                        "attempt": attempt,
-                        "process_index": proc,
-                        "phase": phase,
-                        "p95_s": round(p95, 6),
-                        "fleet_p95_s": round(fleet, 6),
-                        "score_mads": round(score, 2),
-                        "hosts": len(per_host),
-                        "samples": per_host[proc].get("count", 0),
-                    }
-                )
+                finding = {
+                    "attempt": attempt,
+                    "process_index": proc,
+                    "phase": phase,
+                    "p95_s": round(p95, 6),
+                    "fleet_p95_s": round(fleet, 6),
+                    "score_mads": round(score, 2),
+                    "hosts": len(per_host),
+                    "samples": per_host[proc].get("count", 0),
+                }
+                if phase.startswith("stage"):
+                    # the pipeline-stage dimension: name the stage
+                    finding["stage"] = int(phase[5:])
+                findings.append(finding)
     findings.sort(key=lambda f: -f["score_mads"])
     return findings
 
@@ -169,23 +198,30 @@ def emit_straggler_events(bus, events, **kwargs) -> list[dict]:
 
 def format_table(events) -> list[str]:
     """The per-host phase table as report lines (empty when the stream
-    carries no per-host phase sketches)."""
+    carries no per-host phase sketches).  Pipeline runs add one
+    ``stage{s}`` column per pipeline stage — the per-(host, stage) view
+    behind stage-naming straggler findings."""
     table = host_phase_table(events)
     if not table:
         return []
+    phases_seen: set[str] = set()
+    for per_proc in table.values():
+        for per_phase in per_proc.values():
+            phases_seen.update(per_phase)
+    columns = _phase_columns(phases_seen)
     flagged = {
         (f["attempt"], f["process_index"], f["phase"]): f["score_mads"]
         for f in straggler_findings(events)
     }
     lines = ["  per-host step phases (p95 seconds; * = straggler):"]
     header = f"    {'attempt':>7} {'proc':>4}" + "".join(
-        f" {p:>12}" for p in STEP_PHASES
+        f" {p:>12}" for p in columns
     )
     lines.append(header)
     for attempt in sorted(table):
         for proc in sorted(table[attempt]):
             cells = []
-            for phase in STEP_PHASES:
+            for phase in columns:
                 cell = table[attempt][proc].get(phase)
                 if cell is None:
                     cells.append(f" {'-':>12}")
@@ -198,8 +234,11 @@ def format_table(events) -> list[str]:
     for (attempt, proc, phase), score in sorted(
         flagged.items(), key=lambda kv: -kv[1]
     ):
+        stage_note = (
+            f" (pipeline stage {phase[5:]})" if phase.startswith("stage") else ""
+        )
         lines.append(
             f"    straggler: attempt {attempt} process {proc} "
-            f"phase {phase} ({score:.1f} MADs above the fleet)"
+            f"phase {phase}{stage_note} ({score:.1f} MADs above the fleet)"
         )
     return lines

@@ -26,7 +26,14 @@ topology; variants are configurations of it:
   capability the reference lacks).
 """
 
-from .mesh import make_mesh, mesh_shape_for_backend
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    elastic_mesh_shape,
+    make_mesh,
+    mesh_shape_for_backend,
+)
 from .sharding import (
     batch_sharding,
     replicated_sharding,
@@ -47,6 +54,7 @@ from .comms import (
     make_compressed_allreduce,
     opt_state_bytes,
     quantize_tree,
+    wire_psum,
     zero_opt_shardings,
     zero_partition_spec,
 )
@@ -61,16 +69,25 @@ from .ring import (
 )
 from .pipeline import (
     make_1f1b_fwd_bwd,
+    make_interleaved_fwd_bwd,
     make_pipeline_trunk,
     make_pipelined_apply_fn,
+    pipeline_residual_spec,
     pipeline_stages,
     pipelined_vit_apply,
     pp_state_shardings,
+    pp_trunk_specs,
+    schedule_meta,
 )
 
 __all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+    "elastic_mesh_shape",
     "make_mesh",
     "mesh_shape_for_backend",
+    "wire_psum",
     "Comms",
     "make_compressed_allreduce",
     "opt_state_bytes",
@@ -100,8 +117,12 @@ __all__ = [
     "make_sequence_apply_fn",
     "pipeline_stages",
     "make_1f1b_fwd_bwd",
+    "make_interleaved_fwd_bwd",
     "make_pipeline_trunk",
     "pipelined_vit_apply",
     "make_pipelined_apply_fn",
+    "pipeline_residual_spec",
     "pp_state_shardings",
+    "pp_trunk_specs",
+    "schedule_meta",
 ]

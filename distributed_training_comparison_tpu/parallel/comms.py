@@ -259,6 +259,7 @@ class Comms:
         *,
         shard_optim: bool = False,
         grad_comms: str = "fp32",
+        wire_inline: bool = False,
     ) -> None:
         if grad_comms not in GRAD_COMMS_MODES:
             raise ValueError(
@@ -268,6 +269,13 @@ class Comms:
         self.mesh = mesh
         self.shard_optim = bool(shard_optim)
         self.grad_comms = grad_comms
+        # wire_inline: a runner that OWNS its backward (the pipeline
+        # fwd_bwd) already carried the gradients over the compressed wire
+        # inside its schedule (``wire_psum``, error feedback included) —
+        # apply_gradients must then NOT re-quantize the already-synced
+        # grads (double compression) and leaves the residual to the step
+        # core, which installs the schedule's own
+        self.wire_inline = bool(wire_inline)
         # params-shaped tree of NamedShardings (None = fully replicated):
         # the base layout the ZeRO rule extends and the all-gather restores
         self.param_shardings = param_shardings
@@ -332,7 +340,7 @@ class Comms:
         the overlap is the compiler's, not a host thread's."""
         residual = state.comms_residual
         new_residual = residual
-        if self.compressing:
+        if self.compressing and not self.wire_inline:
             if residual is not None:
                 # error feedback: re-inject what earlier wires dropped
                 grads = jax.tree_util.tree_map(jnp.add, grads, residual)
@@ -437,6 +445,64 @@ def _opt_base_shardings(opt_state, param_shardings):
 
 
 # ----------------------------------------------------- wire-true collectives
+
+
+def wire_psum(tree, axis: str, mode: str = "fp32", *, residual=None):
+    """The in-``shard_map`` form of :func:`make_compressed_allreduce` — a
+    quantized gradient SUM over ``axis`` for schedule bodies that already
+    run inside a manual mesh (the pipeline fwd_bwd, ``parallel/pipeline
+    .py``), with optional per-device error feedback.
+
+    Same wire formats (fp16 saturating cast; int8 with a shared
+    ``pmax``-agreed scale accumulating in int32), same DynamiQ recipe as
+    ``Comms.apply_gradients``: ``eff = g + residual``, the wire carries
+    ``quantize(eff)``, and ``eff - dequant(wire)`` — exactly the
+    information the wire dropped — becomes the next step's residual.
+    Returns ``(summed, new_residual)``; ``residual=None`` skips the
+    feedback (``new_residual`` comes back ``None``), and ``mode="fp32"``
+    is a plain ``psum`` with the residual passed through untouched.
+    Non-float leaves always cross uncompressed."""
+    if mode not in GRAD_COMMS_MODES:
+        raise ValueError(
+            f"grad-comms mode must be one of {GRAD_COMMS_MODES}, got {mode!r}"
+        )
+    if mode == "fp32":
+        return (
+            jax.tree_util.tree_map(lambda g: jax.lax.psum(g, axis), tree),
+            residual,
+        )
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    r_leaves = (
+        [None] * len(leaves)
+        if residual is None
+        else jax.tree_util.tree_leaves(residual)
+    )
+    summed, new_r = [], []
+    for g, r in zip(leaves, r_leaves):
+        if not _is_float(g):
+            summed.append(jax.lax.psum(g, axis))
+            new_r.append(r)
+            continue
+        eff = g.astype(jnp.float32) + (0.0 if r is None else r)
+        if mode == "fp16":
+            wire = jnp.clip(eff, -_FP16_MAX, _FP16_MAX).astype(jnp.float16)
+            new_r.append(eff - wire.astype(jnp.float32))
+            summed.append(jax.lax.psum(wire, axis).astype(jnp.float32))
+        else:
+            amax = jax.lax.pmax(jnp.max(jnp.abs(eff), initial=0.0), axis)
+            scale = jnp.maximum(amax, _SCALE_FLOOR) / _INT8_LEVELS
+            q = jnp.clip(
+                jnp.round(eff / scale), -_INT8_LEVELS, _INT8_LEVELS
+            ).astype(jnp.int8)
+            new_r.append(eff - q.astype(jnp.float32) * scale)
+            summed.append(
+                jax.lax.psum(q.astype(jnp.int32), axis).astype(jnp.float32)
+                * scale
+            )
+    out = jax.tree_util.tree_unflatten(treedef, summed)
+    if residual is None:
+        return out, None
+    return out, jax.tree_util.tree_unflatten(treedef, new_r)
 
 
 def make_compressed_allreduce(

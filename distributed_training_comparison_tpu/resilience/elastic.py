@@ -116,6 +116,67 @@ def divisibility_help(
     return "; ".join(parts)
 
 
+def microbatch_help(
+    batch_size: int,
+    microbatches: int,
+    data_axis: int = 1,
+    pipe: int | None = None,
+) -> str:
+    """The actionable tail of every pipeline-microbatch refusal, matching
+    the batch-split error style (:func:`divisibility_help`): which
+    microbatch counts THIS batch supports over THIS data axis, and — for
+    the interleaved schedule — the multiple-of-P constraint with the
+    counts that satisfy both."""
+    d = max(1, data_axis)
+    parts = []
+    legal: list[int] = []
+    batch_splits = bool(batch_size) and batch_size % (microbatches * d) == 0
+    if batch_size:
+        legal = [
+            mm
+            for mm in range(1, batch_size + 1)
+            if batch_size % (mm * d) == 0
+        ]
+        # only claim a batch-split failure when the batch actually fails
+        # to split — an interleaved run refused purely for micro % P must
+        # not send the operator off tuning --batch-size
+        if not batch_splits:
+            parts.append(
+                f"batch {batch_size} with --pipeline-microbatches "
+                f"{microbatches} does not split into microbatch shards "
+                f"over data-parallel size {d}"
+            )
+            if legal:
+                parts.append(
+                    f"legal microbatch counts for this batch: {legal[-8:]}"
+                )
+    if not parts:
+        parts.append(
+            f"--pipeline-microbatches {microbatches} is not a multiple of "
+            f"the pipeline-stage count"
+        )
+    if pipe and pipe > 1:
+        interleaved = [mm for mm in (legal or []) if mm % pipe == 0]
+        parts.append(
+            f"the interleaved schedule additionally needs a multiple of "
+            f"the stage count {pipe}"
+            + (f": {interleaved[-8:]}" if interleaved else "")
+        )
+    return "; ".join(parts)
+
+
+def pipeline_help(depth: int, pipe: int, virtual: int = 1) -> str:
+    """The actionable tail of a pipe-axis refusal: which pipeline degrees
+    THIS model depth supports (at the requested virtual-stage count)."""
+    v = max(1, virtual)
+    legal = [p for p in range(1, depth + 1) if depth % (p * v) == 0]
+    return (
+        f"model depth {depth} does not split into {pipe} pipeline "
+        f"stage(s) x {v} virtual stage(s); legal --pipeline-parallel "
+        f"values at virtual={v}: {legal[-8:]}"
+    )
+
+
 def validate_reshard(
     manifest: dict | None,
     mesh,
@@ -123,6 +184,7 @@ def validate_reshard(
     batch_size: int,
     grad_accum: int = 1,
     shard_optim: bool = False,
+    pipeline: dict | None = None,
 ) -> dict:
     """The explicit reshard step of an elastic restore: validate the saved
     mesh against the re-rendered one and the global batch against the new
@@ -147,6 +209,43 @@ def validate_reshard(
             + divisibility_help(batch_size, data_axis, grad_accum)
             + f" (restoring onto mesh {now_shape})"
         )
+    # the pipe-axis half of the reshard step: restoring onto a CHANGED
+    # pipeline degree is legal exactly when the stacked trunk re-slices
+    # (depth % (pipe x virtual) == 0) and the microbatch count still
+    # splits the batch over the new data axis — refuse with the numbers
+    # otherwise, BEFORE tracing into a doomed staged jit
+    pipe_size = int(now_shape.get("pipe", 1))
+    if pipeline:
+        depth = int(pipeline.get("depth", 0))
+        virtual = int(pipeline.get("virtual", 1)) or 1
+        micro = int(pipeline.get("microbatches", 0))
+        eff_pipe = int(pipeline.get("pipe", pipe_size)) or pipe_size
+        if depth and eff_pipe > 1 and depth % (eff_pipe * virtual):
+            raise ReshardError(
+                "pipe-axis reshard refused: "
+                + pipeline_help(depth, eff_pipe, virtual)
+                + f" (restoring onto mesh {now_shape})"
+            )
+        # the PER-UPDATE batch is what splits into microbatch shards —
+        # same unit as the Trainer's own check, matching the data-axis
+        # rule above (a grad_accum>1 restore refused here, at the launch
+        # boundary, instead of after a full process start + compile)
+        per_update = batch_size // max(1, grad_accum)
+        if micro and per_update and per_update % (micro * data_axis):
+            raise ReshardError(
+                "pipe-axis reshard refused: "
+                + microbatch_help(
+                    per_update, micro, data_axis,
+                    pipe=eff_pipe if virtual > 1 else None,
+                )
+                + f" (restoring onto mesh {now_shape})"
+            )
+        if virtual > 1 and micro and micro % eff_pipe:
+            raise ReshardError(
+                "pipe-axis reshard refused: "
+                + microbatch_help(per_update, micro, data_axis, pipe=eff_pipe)
+                + f" (restoring onto mesh {now_shape})"
+            )
     saved_mesh = (manifest or {}).get("mesh")
     saved_devices = (manifest or {}).get("devices")
     changed = bool(manifest) and (
@@ -159,6 +258,7 @@ def validate_reshard(
     # the delta is recorded so the restore log can say so.  Manifests from
     # before the comms layer carry no key; treated as "unchanged".
     saved_shard_optim = (manifest or {}).get("shard_optim")
+    saved_pipe = (saved_mesh or {}).get("pipe") if saved_mesh else None
     return {
         "changed": changed,
         "saved_mesh": saved_mesh,
@@ -168,6 +268,11 @@ def validate_reshard(
         "devices": jax.device_count(),
         "processes": jax.process_count(),
         "per_device_batch": batch_size // data_axis,
+        "saved_pipe": saved_pipe,
+        "pipe": pipe_size,
+        "pipe_changed": (
+            saved_pipe is not None and int(saved_pipe) != pipe_size
+        ),
         "saved_shard_optim": saved_shard_optim,
         "shard_optim": bool(shard_optim),
         "shard_optim_changed": (

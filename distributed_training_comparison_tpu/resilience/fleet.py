@@ -95,6 +95,7 @@ def widest_legal_world(
     batch_size: int = 0,
     local_devices: int = 0,
     model_parallel: int = 1,
+    pipeline_parallel: int = 1,
     grad_accum: int = 1,
 ) -> int | None:
     """The widest world size ``W <= n_hosts`` whose mesh and batch split
@@ -115,12 +116,14 @@ def widest_legal_world(
     unit = max(1, grad_accum)
     for w in range(int(n_hosts), 0, -1):
         if local > 0:
-            shape = elastic_mesh_shape(w * local, model_parallel)
+            shape = elastic_mesh_shape(
+                w * local, model_parallel, pipeline_parallel
+            )
             if shape is None:
                 continue
             if batch_size and batch_size % (shape[0] * unit):
                 continue
-        elif model_parallel == 1:
+        elif model_parallel == 1 and pipeline_parallel == 1:
             # unknown devices/host, pure data parallel: the data axis is a
             # multiple of W, so batch % W is a necessary condition
             if batch_size and batch_size % (w * unit):
@@ -154,6 +157,7 @@ class FleetSupervisor(Supervisor):
         batch_size: int = 0,
         local_devices: int = 0,
         model_parallel: int = 1,
+        pipeline_parallel: int = 1,
         grad_accum: int = 1,
         min_hosts: int = 1,
         grace_s: float = 15.0,
@@ -170,6 +174,7 @@ class FleetSupervisor(Supervisor):
         self.batch_size = int(batch_size)
         self.local_devices = int(local_devices)
         self.model_parallel = max(1, int(model_parallel))
+        self.pipeline_parallel = max(1, int(pipeline_parallel))
         self.grad_accum = max(1, int(grad_accum))
         self.min_hosts = max(1, int(min_hosts))
         self.grace_s = max(0.0, float(grace_s))
@@ -264,6 +269,7 @@ class FleetSupervisor(Supervisor):
             batch_size=self.batch_size,
             local_devices=self.local_devices,
             model_parallel=self.model_parallel,
+            pipeline_parallel=self.pipeline_parallel,
             grad_accum=self.grad_accum,
         )
         if world is None or world < self.min_hosts:
@@ -282,7 +288,10 @@ class FleetSupervisor(Supervisor):
                 mesh_w = next(
                     (
                         w for w in range(len(active), 0, -1)
-                        if elastic_mesh_shape(w * local, self.model_parallel)
+                        if elastic_mesh_shape(
+                            w * local, self.model_parallel,
+                            self.pipeline_parallel,
+                        )
                     ),
                     None,
                 )
@@ -294,7 +303,8 @@ class FleetSupervisor(Supervisor):
                     )
                 else:
                     shape = elastic_mesh_shape(
-                        mesh_w * local, self.model_parallel
+                        mesh_w * local, self.model_parallel,
+                        self.pipeline_parallel,
                     )
                     detail = divisibility_help(
                         self.batch_size, shape[0], self.grad_accum
@@ -539,6 +549,7 @@ def fleet_env_knobs(hparams) -> dict:
         "batch_size": int(getattr(hparams, "batch_size", 0) or 0),
         "local_devices": int(getattr(hparams, "fleet_local_devices", 0) or 0),
         "model_parallel": int(getattr(hparams, "model_parallel", 1) or 1),
+        "pipeline_parallel": int(getattr(hparams, "pipeline_parallel", 1) or 1),
         "grad_accum": int(getattr(hparams, "grad_accum", 1) or 1),
         "min_hosts": int(getattr(hparams, "fleet_min_hosts", 1) or 1),
         "grace_s": float(getattr(hparams, "fleet_grace_secs", 15.0)),

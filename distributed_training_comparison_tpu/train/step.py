@@ -140,6 +140,7 @@ def _make_step_core(
     accum_sharding=None,
     fwd_bwd=None,
     comms=None,
+    repl_sharding=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """The shared train core: augment → normalize → fwd/bwd → SGD update.
 
@@ -185,11 +186,26 @@ def _make_step_core(
     the benign path's executable is byte-identical.
     """
     comms_active = comms is not None and comms.active
+    # a fwd_bwd that OWNS its gradient-sync wire (the compressed pipeline
+    # schedule) threads the per-device error-feedback residual through the
+    # step: state.comms_residual rides in, the schedule's new residual
+    # rides out (and a guarded non-finite step keeps the old one, like
+    # every other state field)
+    residual_through_fwd_bwd = fwd_bwd is not None and getattr(
+        fwd_bwd, "carries_residual", False
+    )
     compute_dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
 
-    def forward_backward(params, apply_fn, batch_stats, images, labels, key):
+    def forward_backward(
+        params, apply_fn, batch_stats, images, labels, key, residual=None
+    ):
         if augment:
-            images = random_crop_flip(images, key)
+            # draw_sharding pins the crop/flip draws replicated: without
+            # it GSPMD may partition the threefry generation differently
+            # per mesh shape, and the SAME (seed, epoch, step) would
+            # augment differently under DP than under DP×TP×PP
+            # (data/augment.py) — breaking cross-layout trajectory parity
+            images = random_crop_flip(images, key, draw_sharding=repl_sharding)
         x = normalize_images(images, mean, std, dtype=compute_dtype)
 
         if fwd_bwd is not None:
@@ -203,9 +219,14 @@ def _make_step_core(
                     "apply_fn, so BatchNorm running statistics would "
                     "silently freeze); got a non-empty batch_stats tree"
                 )
-            loss, logits, grads = fwd_bwd(params, x, labels)
+            if residual_through_fwd_bwd:
+                loss, logits, grads, residual = fwd_bwd(
+                    params, x, labels, residual
+                )
+            else:
+                loss, logits, grads = fwd_bwd(params, x, labels)
             top1, _ = _topk_hits(logits, labels)
-            return grads, batch_stats, loss, top1.sum(), {}
+            return grads, batch_stats, loss, top1.sum(), {}, residual
 
         def loss_fn(p):
             logits, mutated = apply_fn(
@@ -231,12 +252,16 @@ def _make_step_core(
         # BN-free models mutate nothing; keep the (empty) stats tree stable
         new_stats = mutated.get("batch_stats", batch_stats)
         extras = _moe_health(mutated.get("moe_metrics", {}))
-        return grads, new_stats, loss, top1.sum(), extras
+        return grads, new_stats, loss, top1.sum(), extras, residual
 
     def core(state: TrainState, images, labels, key: jax.Array, fault_scale=None):
+        res0 = state.comms_residual if residual_through_fwd_bwd else None
         if grad_accum <= 1:
-            grads, new_stats, loss, top1_count, extras = forward_backward(
-                state.params, state.apply_fn, state.batch_stats, images, labels, key
+            grads, new_stats, loss, top1_count, extras, new_residual = (
+                forward_backward(
+                    state.params, state.apply_fn, state.batch_stats,
+                    images, labels, key, res0,
+                )
             )
         else:
             a = grad_accum
@@ -257,20 +282,23 @@ def _make_step_core(
             micro_keys = jax.random.split(key, a)
 
             def micro_step(carry, inp):
-                grads_sum, batch_stats = carry
+                grads_sum, batch_stats, res = carry
                 bx, by, k = inp
-                grads, new_stats, loss, top1_count, extras = forward_backward(
-                    state.params, state.apply_fn, batch_stats, bx, by, k
+                grads, new_stats, loss, top1_count, extras, res = (
+                    forward_backward(
+                        state.params, state.apply_fn, batch_stats, bx, by, k,
+                        res,
+                    )
                 )
                 grads_sum = jax.tree_util.tree_map(jnp.add, grads_sum, grads)
-                return (grads_sum, new_stats), {
+                return (grads_sum, new_stats, res), {
                     "loss": loss, "top1": top1_count, **extras
                 }
 
             zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-            (grads_sum, new_stats), stacked = jax.lax.scan(
+            (grads_sum, new_stats, new_residual), stacked = jax.lax.scan(
                 micro_step,
-                (zero_grads, state.batch_stats),
+                (zero_grads, state.batch_stats, res0),
                 (micro_images, micro_labels, micro_keys),
             )
             grads = jax.tree_util.tree_map(lambda g: g / a, grads_sum)
@@ -294,6 +322,10 @@ def _make_step_core(
             )
         else:
             new_state = state.apply_gradients(grads=grads, batch_stats=new_stats)
+        if residual_through_fwd_bwd and new_residual is not None:
+            # the schedule's own wire residual (comms.wire_inline left the
+            # field alone); a skipped step still reverts it via select_tree
+            new_state = new_state.replace(comms_residual=new_residual)
         state = select_tree(finite, new_state, state)
         metrics = {
             "loss": loss,
@@ -342,7 +374,8 @@ def make_train_step(
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(
-        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd, comms
+        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd,
+        comms, repl,
     )
 
     # No buffer donation here: this per-step path serves benchmarks and
@@ -518,7 +551,8 @@ def make_chunk_runner(
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(
-        precision, augment, mean, std, grad_accum, chunk_shard, fwd_bwd, comms
+        precision, augment, mean, std, grad_accum, chunk_shard, fwd_bwd,
+        comms, repl,
     )
 
     def _run(state: TrainState, images, labels, epoch_key: jax.Array, start, fault):
@@ -602,7 +636,8 @@ def make_device_chunk_runner(
     state_sh = state_sharding if state_sharding is not None else repl
     accum_shard = batch_sharding(mesh, axis=1)
     core = _make_step_core(
-        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd, comms
+        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd,
+        comms, repl,
     )
 
     def _run(state: TrainState, images, labels, key: jax.Array, epoch, start, fault):
@@ -693,7 +728,8 @@ def make_epoch_runner(
     repl = replicated_sharding(mesh)
     state_sh = state_sharding if state_sharding is not None else repl
     core = _make_step_core(
-        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd, comms
+        precision, augment, mean, std, grad_accum, accum_shard, fwd_bwd,
+        comms, repl,
     )
 
     def _run(state: TrainState, images, labels, key: jax.Array, epoch, fault):

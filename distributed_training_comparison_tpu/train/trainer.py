@@ -131,7 +131,10 @@ class Trainer:
             self.fault_plan is not None and self.fault_plan.has_step_faults()
         )
         self.mesh = mesh if mesh is not None else make_mesh(
-            hparams.num_devices, hparams.model_parallel, backend=hparams.backend
+            hparams.num_devices,
+            hparams.model_parallel,
+            getattr(hparams, "pipeline_parallel", 1) or 1,
+            backend=hparams.backend,
         )
         n_data = self.mesh.shape["data"]
         ga = getattr(hparams, "grad_accum", 1)
@@ -197,7 +200,7 @@ class Trainer:
                 getattr(hparams, "model_parallel", 1) > 1
                 and getattr(hparams, "parallel_style", "tensor")
                 in ("tensor", "pipeline")
-            ):
+            ) or getattr(hparams, "pipeline_parallel", 1) > 1:
                 if fusion == "force":
                     raise ValueError(
                         "--block-fusion force requires unsharded block "
@@ -266,67 +269,165 @@ class Trainer:
                 self.model, init_key, self.tx, input_shape=(1, size, size, 3)
             )
         # The "model" axis's meaning is the --parallel-style: 'tensor'
-        # (Megatron param sharding, the default), 'pipeline' (GPipe over
-        # the stacked transformer trunk, stage-sharded params), or
-        # 'sequence'/'sequence-ulysses' (token axis sharded across the
-        # trunk; params stay fully replicated — sequence parallelism
-        # shards activations, not parameters).  At model_parallel == 1
-        # every style degenerates to the replicated tensor path.
+        # (Megatron param sharding, the default), 'pipeline' (the LEGACY
+        # single-axis pipeline spelling: the schedule runs on the model
+        # axis itself), or 'sequence'/'sequence-ulysses' (token axis
+        # sharded across the trunk; params stay fully replicated —
+        # sequence parallelism shards activations, not parameters).  The
+        # DEDICATED "pipe" axis (--pipeline-parallel, parallel/mesh.py)
+        # composes with the tensor style: the trunk shards (pipe on the
+        # depth axis, model on the feature dims) — DP×TP×PP.  At
+        # model_parallel == pipeline_parallel == 1 every style
+        # degenerates to the replicated tensor path.
         style = getattr(hparams, "parallel_style", "tensor")
         mp_size = self.mesh.shape["model"]
-        if style != "tensor" and mp_size > 1:
+        pp_size = self.mesh.shape.get("pipe", 1)
+        # comms flags are read early: the pipeline schedules OWN their
+        # gradient-sync wire, so the fwd_bwd build below needs the mode
+        self.shard_optim = bool(getattr(hparams, "shard_optim", False))
+        self.grad_comms = getattr(hparams, "grad_comms", "fp32") or "fp32"
+        legacy_pipe = style == "pipeline" and mp_size > 1
+        pipe_axis = "pipe" if pp_size > 1 else "model"
+        pipe_size = pp_size if pp_size > 1 else (mp_size if legacy_pipe else 1)
+        tp_axis = "model" if (pp_size > 1 and mp_size > 1) else None
+        pipeline_active = pipe_size > 1
+        self._pipe_meta = None
+        self._local_stages: list[int] = []
+        self._residual_spec_fn = None  # pipeline wire: params -> (zeros, sh)
+        if (style != "tensor" and mp_size > 1) or pipeline_active:
             from ..models.vit import ViT
 
+            what = (
+                f"--pipeline-parallel {pp_size}"
+                if pp_size > 1
+                else f"--parallel-style {style}"
+            )
             if not isinstance(self.model, ViT):
                 raise ValueError(
-                    f"--parallel-style {style} needs a stacked transformer "
+                    f"{what} needs a stacked transformer "
                     f"trunk (vit_* models); got --model {hparams.model}"
-                )
-            if style == "pipeline" and self.model.depth % mp_size:
-                # fail at the CLI, not from inside jit tracing of the
-                # staged trunk (advisor r2)
-                raise ValueError(
-                    f"--parallel-style pipeline needs model depth "
-                    f"({self.model.depth}) divisible by the model-parallel "
-                    f"mesh axis ({mp_size}) to form equal stages"
                 )
             if getattr(self.model, "num_experts", 0):
                 # the staged/sequence apply paths neither thread the sown
                 # MoE aux loss nor define per-shard routing semantics;
                 # experts shard over "model" under the tensor style (EP)
                 raise ValueError(
-                    f"--parallel-style {style} does not support MoE models; "
+                    f"{what} does not support MoE models; "
                     "use the default tensor style, where --model-parallel "
                     "shards the expert axis (expert parallelism)"
                 )
+            if style.startswith("sequence") and pp_size > 1:
+                raise ValueError(
+                    "--pipeline-parallel does not compose with the "
+                    "sequence styles (the trunk cannot be both staged and "
+                    "token-sharded); use --parallel-style tensor"
+                )
         self.train_fwd_bwd = None  # 1F1B replaces value_and_grad when set
-        if style == "pipeline" and mp_size > 1:
+        if pipeline_active:
             from ..parallel.pipeline import (
-                make_1f1b_fwd_bwd,
+                make_interleaved_fwd_bwd,
                 make_pipelined_apply_fn,
+                pipeline_residual_spec,
                 pp_state_shardings,
+                schedule_meta,
             )
+            from ..resilience.elastic import microbatch_help, pipeline_help
 
-            micro = getattr(hparams, "pipeline_microbatches", 0) or 4 * mp_size
+            schedule = getattr(hparams, "pipeline_schedule", "gpipe")
+            virtual = getattr(hparams, "pipeline_virtual_stages", 0) or (
+                2 if schedule == "interleaved" else 1
+            )
+            if schedule != "interleaved":
+                virtual = 1
+            if self.model.depth % (pipe_size * virtual):
+                # fail at the CLI, not from inside jit tracing of the
+                # staged trunk (advisor r2)
+                raise ValueError(
+                    "pipeline stages refused: "
+                    + pipeline_help(self.model.depth, pipe_size, virtual)
+                )
+            if tp_axis is not None:
+                if self.model.heads % mp_size:
+                    raise ValueError(
+                        f"DP×TP×PP needs attention heads "
+                        f"({self.model.heads}) divisible by "
+                        f"--model-parallel ({mp_size}) for head-local "
+                        "tensor-parallel attention"
+                    )
+                if (self.model.mlp_ratio * self.model.dim) % mp_size:
+                    raise ValueError(
+                        f"DP×TP×PP needs the MLP hidden width "
+                        f"({self.model.mlp_ratio * self.model.dim}) "
+                        f"divisible by --model-parallel ({mp_size})"
+                    )
+            micro = getattr(hparams, "pipeline_microbatches", 0) or (
+                4 * pipe_size
+            )
+            if virtual > 1 and micro % pipe_size:
+                raise ValueError(
+                    "pipeline microbatch split impossible: "
+                    + microbatch_help(
+                        hparams.batch_size, micro, n_data, pipe=pipe_size
+                    )
+                )
             per_micro = hparams.batch_size // self.grad_accum
             if per_micro % (micro * n_data):
                 raise ValueError(
-                    f"per-update batch {per_micro} not divisible by "
-                    f"pipeline microbatches ({micro}) x data-parallel size "
-                    f"({n_data}); adjust --batch-size/--pipeline-microbatches"
+                    f"per-update batch {per_micro}: "
+                    + microbatch_help(
+                        per_micro, micro, n_data,
+                        pipe=pipe_size if virtual > 1 else None,
+                    )
                 )
             # eval always runs the (forward-only) GPipe schedule; the
             # train-time backward is picked by --pipeline-schedule
             state = state.replace(
                 apply_fn=make_pipelined_apply_fn(
-                    self.model, self.mesh, num_microbatches=micro
+                    self.model, self.mesh, num_microbatches=micro,
+                    pipe_axis=pipe_axis, tp_axis=tp_axis,
                 )
             )
-            if getattr(hparams, "pipeline_schedule", "gpipe") == "1f1b":
-                self.train_fwd_bwd = make_1f1b_fwd_bwd(
-                    self.model, self.mesh, num_microbatches=micro
+            if schedule in ("1f1b", "interleaved"):
+                # the 1F1B family owns its backward — and therefore its
+                # gradient-sync wire: --grad-comms here is the WIRE-TRUE
+                # compressed all-reduce (fp16/int8 payload really crosses
+                # the data axis, per-device error feedback), the path the
+                # GSPMD runners cannot take (parallel/comms.py)
+                self.train_fwd_bwd = make_interleaved_fwd_bwd(
+                    self.model, self.mesh, num_microbatches=micro,
+                    virtual=virtual, pipe_axis=pipe_axis, tp_axis=tp_axis,
+                    grad_comms=self.grad_comms,
                 )
-            self.state_sharding = pp_state_shardings(self.mesh, state)
+                if self.train_fwd_bwd.carries_residual:
+                    self._residual_spec_fn = (
+                        lambda params, _v=virtual, _pa=pipe_axis, _ta=tp_axis: (
+                            pipeline_residual_spec(
+                                params, self.mesh, virtual=_v,
+                                pipe_axis=_pa, tp_axis=_ta,
+                            )
+                        )
+                    )
+            self.state_sharding = pp_state_shardings(
+                self.mesh, state, pipe_axis=pipe_axis, tp_axis=tp_axis
+            )
+            self._pipe_meta = {
+                **schedule_meta(schedule, pipe_size, micro, virtual),
+                "pipe_axis": pipe_axis,
+                "tp": mp_size if tp_axis is not None else 1,
+                "data": n_data,
+                "depth": self.model.depth,
+            }
+            # the pipe coordinates this process's devices own — the
+            # (host, stage) span lanes and per-stage straggler sketches
+            # are recorded for exactly these
+            ax = list(self.mesh.axis_names).index(pipe_axis)
+            self._local_stages = sorted(
+                {
+                    pos[ax]
+                    for pos, dev in np.ndenumerate(self.mesh.devices)
+                    if dev.process_index == jax.process_index()
+                }
+            )
         elif style.startswith("sequence") and mp_size > 1:
             from ..parallel.ring import make_sequence_apply_fn
             from ..parallel.sharding import replicated_sharding
@@ -348,8 +449,8 @@ class Trainer:
         # update (--shard-optim) + compressed gradient sync (--grad-comms).
         # Both off (the default) leaves self.comms inactive and the traced
         # update — and therefore every executable fingerprint — unchanged.
-        self.shard_optim = bool(getattr(hparams, "shard_optim", False))
-        self.grad_comms = getattr(hparams, "grad_comms", "fp32") or "fp32"
+        # (shard_optim/grad_comms were read above, before the pipeline
+        # block: the 1F1B schedules carry the wire themselves.)
         self.comms = None
         if self.shard_optim or self.grad_comms != "fp32":
             self.comms = comms_mod.Comms(
@@ -357,17 +458,32 @@ class Trainer:
                 param_shardings=self.state_sharding.params,
                 shard_optim=self.shard_optim,
                 grad_comms=self.grad_comms,
+                # the pipeline schedule already moved the gradients over
+                # the compressed wire (error feedback included) inside its
+                # own backward — apply_gradients must not re-quantize
+                wire_inline=self._residual_spec_fn is not None,
             )
             if self.grad_comms != "fp32":
-                # error-feedback residual: params-shaped fp32, carried in
-                # the train state (laid out like the params), NOT
-                # checkpointed — a resume restarts it at zero
-                state = state.replace(
-                    comms_residual=self.comms.residual_init(state.params)
-                )
-                self.state_sharding = self.state_sharding.replace(
-                    comms_residual=self.state_sharding.params
-                )
+                if self._residual_spec_fn is not None:
+                    # wire-true pipeline sync: the error-feedback residual
+                    # is PER-DEVICE state in the schedule layout (leading
+                    # data axis + chunk view), not params-shaped — each
+                    # data replica carries the error its own wire dropped
+                    host_res, res_sh = self._residual_spec_fn(state.params)
+                    state = state.replace(comms_residual=host_res)
+                    self.state_sharding = self.state_sharding.replace(
+                        comms_residual=res_sh
+                    )
+                else:
+                    # GSPMD runners: params-shaped fp32 residual, carried
+                    # in the train state (laid out like the params), NOT
+                    # checkpointed — a resume restarts it at zero
+                    state = state.replace(
+                        comms_residual=self.comms.residual_init(state.params)
+                    )
+                    self.state_sharding = self.state_sharding.replace(
+                        comms_residual=self.state_sharding.params
+                    )
             if self.shard_optim:
                 # the whole re-layout: the optimizer state is CARRIED
                 # data-sharded between dispatches (per-device opt-state HBM
@@ -595,6 +711,14 @@ class Trainer:
                 manifest, self.mesh,
                 batch_size=hparams.batch_size, grad_accum=self.grad_accum,
                 shard_optim=self.shard_optim,
+                pipeline=(
+                    {
+                        k: self._pipe_meta[k]
+                        for k in ("pipe", "virtual", "microbatches", "depth")
+                    }
+                    if self._pipe_meta is not None
+                    else None
+                ),
             )
             if self._reshard.get("shard_optim_changed"):
                 # checkpoints are host pytrees, so crossing --shard-optim
@@ -686,6 +810,15 @@ class Trainer:
             resume_step_offset=self._resume_step_offset,
             init_s=round(self._init_secs, 4),
         )
+        if self._pipe_meta is not None:
+            # one `pipeline` event per attempt: the schedule's static tick
+            # arithmetic (run_report joins it with the measured dispatch
+            # sketches into the per-executable bubble table) + the static
+            # bubble gauge on the registry
+            self.bus.emit("pipeline", **self._pipe_meta)
+            self.metrics.gauge("pipeline/bubble_frac_schedule").set(
+                self._pipe_meta["bubble_frac"]
+            )
 
     # ------------------------------------------------------------------ utils
 
@@ -807,16 +940,20 @@ class Trainer:
             return state
         return state.replace(comms_residual=None)
 
-    @staticmethod
-    def _reset_comms_residual(state):
+    def _reset_comms_residual(self, state):
         """Restart the compressed-sync error-feedback residual at zero
         (resume and rollback both land here: the residual is never
         checkpointed, and a rolled-back residual belonged to the
         discarded trajectory).  HOST zeros, deliberately — both callers
         feed ``place_tree``, whose multi-host branch cannot re-place a
-        live partitioned device leaf."""
+        live partitioned device leaf.  The zeros' SHAPE follows the wire
+        owner: params-shaped for the GSPMD comms path, the per-device
+        schedule layout for the wire-true pipeline sync."""
         if state.comms_residual is None:
             return state
+        if self._residual_spec_fn is not None:
+            host_res, _ = self._residual_spec_fn(state.params)
+            return state.replace(comms_residual=host_res)
         return state.replace(
             comms_residual=jax.tree_util.tree_map(
                 lambda l: np.zeros(l.shape, l.dtype), state.params
@@ -846,6 +983,15 @@ class Trainer:
         # records the delta for the log
         meta["shard_optim"] = self.shard_optim
         meta["grad_comms"] = self.grad_comms
+        if self._pipe_meta is not None:
+            # the pipeline layout the checkpoint was trained under:
+            # restore across a schedule / pipe-degree change is a plain
+            # host-pytree re-placement (validate_reshard checks the new
+            # degree still slices the trunk), and the delta is logged
+            meta["pipeline"] = {
+                k: self._pipe_meta[k]
+                for k in ("schedule", "pipe", "virtual", "microbatches")
+            }
         quarantined = getattr(self.train_loader, "quarantined", None)
         if quarantined:
             meta["quarantined"] = sorted(quarantined)
@@ -895,6 +1041,47 @@ class Trainer:
                 "state_snapshot", sentinel=False,
             )
         return self._snapshot_fn(state)
+
+    def _note_pipeline_obs(self, t0: float, t1: float) -> None:
+        """Per-dispatch pipeline observability (pipeline runs only): one
+        synthetic span-lane triple per LOCAL stage — the fill/busy/drain
+        trapezoid of the schedule scaled onto the measured dispatch
+        interval, so the Perfetto timeline renders the bubble structure a
+        device trace would show — plus a per-stage busy-seconds histogram
+        (``step/stage{s}/busy_s``) the straggler attribution scores
+        cross-host, giving findings a STAGE name, not just a host.  The
+        proportions are the schedule's static tick arithmetic
+        (``schedule_meta``); the interval is the measured one."""
+        meta = self._pipe_meta
+        if meta is None or t1 <= t0:
+            return
+        if self._step_meter.last_compiled:
+            # mirror the host phase sketches' compile-taint split: a
+            # dispatch that compiled would dominate every stage's busy
+            # sketch and star the host as a straggler for the attempt
+            return
+        span = t1 - t0
+        ticks = meta["ticks"]
+        for s in self._local_stages:
+            fill = meta["fill_ticks"][s] / ticks * span
+            drain = meta["drain_ticks"][s] / ticks * span
+            lane = f"stage{s}"
+            if fill > 0:
+                self.tracer.record(
+                    "pp_fill_bubble", t0, t0 + fill, lane=lane, stage=s
+                )
+            self.tracer.record(
+                "pp_busy", t0 + fill, t1 - drain, lane=lane, stage=s,
+                schedule=meta["schedule"], virtual=meta["virtual"],
+                bubble_frac=meta["bubble_frac"],
+            )
+            if drain > 0:
+                self.tracer.record(
+                    "pp_drain_bubble", t1 - drain, t1, lane=lane, stage=s
+                )
+            self.metrics.histogram(f"step/stage{s}/busy_s").record(
+                max(0.0, span - fill - drain)
+            )
 
     def _device_runner_for(self, take: int):
         """The compiled device-mode chunk runner for a ``take``-step chunk
@@ -1351,7 +1538,10 @@ class Trainer:
         report = check_desync(
             float(self._fingerprint_fn(self.state.params)), inject=inject
         )
-        if self.mesh.shape["model"] > 1 and not report["mismatch"]:
+        sharded_axes = self.mesh.shape["model"] > 1 or (
+            self.mesh.shape.get("pipe", 1) > 1
+        )
+        if sharded_axes and not report["mismatch"]:
             from ..health import check_partial_desync
 
             partial = check_partial_desync(self._partial_matrix())
@@ -1843,6 +2033,7 @@ class Trainer:
             # StepTraceAnnotations (same id as the annotation above);
             # taint= keeps a compile-bearing dispatch sample out of the
             # straggler-scored step/dispatch_s sketch
+            t_disp = time.monotonic()
             with ann, meter.phase(
                 "dispatch", taint=self.compile_monitor.take_taint,
                 step=epoch * steps + done,
@@ -1852,6 +2043,8 @@ class Trainer:
                 else:
                     self.state, metrics = runner(*args)
             meter.note_chunk()
+            if self._pipe_meta is not None:
+                self._note_pipeline_obs(t_disp, time.monotonic())
             chunk_metrics.append(metrics)  # (take,) device arrays; no sync
             done += take
             self.metrics.note_steps(take)
@@ -1993,6 +2186,7 @@ class Trainer:
                 )
                 # step arg = the --xplane join key (see the device loop);
                 # taint= excludes compile-bearing samples (see there too)
+                t_disp = time.monotonic()
                 with ann, meter.phase(
                     "dispatch", taint=self.compile_monitor.take_taint,
                     step=epoch * steps + start,
@@ -2006,6 +2200,8 @@ class Trainer:
                     else:
                         self.state, metrics = self.chunk_runner(*args)
                 meter.note_chunk()
+                if self._pipe_meta is not None:
+                    self._note_pipeline_obs(t_disp, time.monotonic())
                 del batch  # donated at dispatch; drop the dead references
                 chunk_metrics.append(metrics)  # (take,) device arrays; no sync
                 done = start + take

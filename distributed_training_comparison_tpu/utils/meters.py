@@ -75,6 +75,10 @@ class StepTimeMeter:
     def reset(self) -> None:
         self.seconds = {p: 0.0 for p in self.PHASES}
         self.chunks = 0
+        # whether the most recent accounted sample carried a compile —
+        # read by derived per-dispatch accounting (the trainer's pipeline
+        # per-stage sketches) that must mirror the compile-taint split
+        self.last_compiled = False
 
     def add(self, phase: str, secs: float, compiled: bool = False) -> None:
         """Account one phase interval.  ``compiled=True`` marks a sample
@@ -87,6 +91,7 @@ class StepTimeMeter:
         reads as faster than peers that genuinely compiled."""
         secs = max(0.0, float(secs))
         self.seconds[phase] += secs
+        self.last_compiled = bool(compiled)
         if self.metrics is not None:
             suffix = "_compile_s" if compiled else "_s"
             self.metrics.histogram(f"step/{phase}{suffix}").record(secs)

@@ -480,7 +480,7 @@ def test_partial_fingerprint_device_path_detects_single_bit_drift(dp_tp_mesh):
     shardings = {"w": tp, "big": repl}
     fn = make_partial_fingerprint_fn(mesh, shardings)
     device = np.asarray(fn(params))
-    assert device.shape == (4, 2)
+    assert device.shape == (4, 2, 1)  # (data, model, pipe)
     # in-sync replicas: every model column constant down the data axis
     assert not check_partial_desync(device)["mismatch"]
     # host path agrees on the in-sync verdict (different checksum, same

@@ -123,11 +123,17 @@ def test_widest_legal_world_shrinks_for_divisibility():
 
 
 def test_elastic_mesh_shape_rederives_axes():
-    assert elastic_mesh_shape(8, 2) == (4, 2)
-    assert elastic_mesh_shape(4, 1) == (4, 1)
+    assert elastic_mesh_shape(8, 2) == (4, 2, 1)
+    assert elastic_mesh_shape(4, 1) == (4, 1, 1)
     assert elastic_mesh_shape(3, 2) is None  # devices don't tile the model axis
     assert elastic_mesh_shape(1, 2) is None  # model axis can't shrink below TP
     assert elastic_mesh_shape(0, 1) is None
+    # the dedicated pipe axis joins the tiling rule: DP x TP x PP
+    assert elastic_mesh_shape(8, 2, 2) == (2, 2, 2)
+    assert elastic_mesh_shape(8, 1, 4) == (2, 1, 4)
+    assert elastic_mesh_shape(4, 2, 2) == (1, 2, 2)
+    assert elastic_mesh_shape(2, 2, 2) is None  # can't shrink below TPxPP
+    assert elastic_mesh_shape(6, 2, 2) is None  # doesn't tile TPxPP
 
 
 def test_divisibility_help_carries_actionable_numbers():
@@ -138,14 +144,14 @@ def test_divisibility_help_carries_actionable_numbers():
 
 
 def test_validate_reshard_plan_and_refusal():
-    mesh = make_mesh(backend="ddp")  # (8, 1) on the test process's devices
+    mesh = make_mesh(backend="ddp")  # (8, 1, 1) on the test process's devices
     plan = validate_reshard(
         {"mesh": {"data": 4, "model": 1}, "devices": 4},
         mesh, batch_size=32,
     )
     assert plan["changed"] is True
     assert plan["saved_mesh"] == {"data": 4, "model": 1}
-    assert plan["mesh"] == {"data": 8, "model": 1}
+    assert plan["mesh"] == {"data": 8, "model": 1, "pipe": 1}
     assert plan["per_device_batch"] == 4
     same = validate_reshard(
         {"mesh": dict(mesh.shape), "devices": jax.device_count()},
@@ -699,12 +705,12 @@ def test_partial_fingerprints_matrix_and_injected_drift():
     )
     params = {"a": repl, "b": shard}
     matrix = partial_fingerprints(params, mesh)
-    assert matrix.shape == (4, 2)
+    assert matrix.shape == (4, 2, 1)  # (data, model, pipe)
     # replicated across data: every model column is constant down axis 0
     assert (matrix.max(axis=0) == matrix.min(axis=0)).all()
     # the sharded leaf makes the two model columns DIFFER (each holds its
     # own half), which is exactly the per-shard visibility the scalar lacks
-    assert matrix[0, 0] != matrix[0, 1]
+    assert matrix[0, 0, 0] != matrix[0, 1, 0]
     # absolute accounting: summing every device's partials recovers the
     # weighted checksums (leaf order: a -> weight 1, b -> weight 2).  The
     # replicated leaf appears once per device (8x1); the model-sharded
@@ -719,7 +725,7 @@ def test_partial_fingerprints_matrix_and_injected_drift():
     assert injected["mismatch"] and injected["spread"] > 0
 
     drifted = matrix.copy()
-    drifted[2, 1] += 0.5  # one replica's model-shard 1 drifted
+    drifted[2, 1, 0] += 0.5  # one replica's model-shard 1 drifted
     report = check_partial_desync(drifted)
     assert report["mismatch"]
     assert report["per_model_spread"][0] == 0.0

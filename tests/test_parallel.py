@@ -21,18 +21,31 @@ from distributed_training_comparison_tpu.parallel import (
 
 
 def test_mesh_shapes_per_backend():
-    assert mesh_shape_for_backend("single", 8) == (1, 1)
-    assert mesh_shape_for_backend("dp", 8) == (8, 1)
-    assert mesh_shape_for_backend("tpu", 8, model_parallel=2) == (4, 2)
+    assert mesh_shape_for_backend("single", 8) == (1, 1, 1)
+    assert mesh_shape_for_backend("dp", 8) == (8, 1, 1)
+    assert mesh_shape_for_backend("tpu", 8, model_parallel=2) == (4, 2, 1)
+    # the dedicated pipe axis composes with the model axis: DP×TP×PP
+    assert (
+        mesh_shape_for_backend("tpu", 8, model_parallel=2, pipeline_parallel=2)
+        == (2, 2, 2)
+    )
+    assert mesh_shape_for_backend("tpu", 8, pipeline_parallel=4) == (2, 1, 4)
     with pytest.raises(ValueError):
         mesh_shape_for_backend("tpu", 8, model_parallel=3)
+    with pytest.raises(ValueError):
+        mesh_shape_for_backend("tpu", 8, model_parallel=2, pipeline_parallel=3)
 
 
 def test_make_mesh_all_devices():
     mesh = make_mesh(backend="dp")
-    assert mesh.shape == {"data": 8, "model": 1}
-    assert make_mesh(backend="single").shape == {"data": 1, "model": 1}
-    assert make_mesh(num_devices=4, backend="ddp").shape == {"data": 4, "model": 1}
+    assert mesh.shape == {"data": 8, "model": 1, "pipe": 1}
+    assert make_mesh(backend="single").shape == {"data": 1, "model": 1, "pipe": 1}
+    assert make_mesh(num_devices=4, backend="ddp").shape == {
+        "data": 4, "model": 1, "pipe": 1,
+    }
+    assert make_mesh(8, 2, 2, backend="tpu").shape == {
+        "data": 2, "model": 2, "pipe": 2,
+    }
 
 
 def test_shard_batch_splits_leading_axis():

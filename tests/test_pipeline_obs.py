@@ -1,0 +1,339 @@
+"""Fast (no-jit) coverage for the composed-pipeline subsystem's seams:
+schedule tick arithmetic, the actionable microbatch/pipe refusals, the
+pipe-axis reshard validation, per-stage straggler phase keys, the
+run_report bubble table, and the synthetic (host, stage) span lanes.
+
+The schedule NUMERICS (interleaved == 1f1b == gpipe == unpipelined) live
+in tests/test_pipeline.py — they compile real meshes and are slow-marked;
+everything here is pure host-side arithmetic and event processing.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_training_comparison_tpu import obs
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.health.desync import (
+    check_partial_desync,
+)
+from distributed_training_comparison_tpu.obs import straggler
+from distributed_training_comparison_tpu.parallel.pipeline import (
+    schedule_meta,
+)
+from distributed_training_comparison_tpu.resilience.elastic import (
+    ReshardError,
+    microbatch_help,
+    pipeline_help,
+    validate_reshard,
+)
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+import run_report  # noqa: E402
+
+
+# ------------------------------------------------ schedule tick arithmetic
+
+
+def test_schedule_meta_1f1b_recovers_textbook_ticks():
+    m = schedule_meta("1f1b", pipe=4, microbatches=8)
+    assert m["ticks"] == 8 + 2 * 4 - 2
+    assert m["useful_ticks"] == 8
+    assert m["virtual"] == 1
+    assert m["bubble_frac"] == pytest.approx((2 * 4 - 2) / (8 + 2 * 4 - 2))
+    # the per-stage trapezoid: stage s fills s ticks at the start, and —
+    # because the 1F1B family ENDS with the backward ripple toward stage
+    # 0 — also finishes s ticks early (last backward of stage s lands at
+    # tick T-1-s): stage 0 is busy until the final tick
+    assert m["fill_ticks"] == [0, 1, 2, 3]
+    assert m["drain_ticks"] == [0, 1, 2, 3]
+    # gpipe is a forward program: stage s finishes P-1-s ticks early
+    assert schedule_meta("gpipe", 4, 8)["drain_ticks"] == [3, 2, 1, 0]
+
+
+def test_schedule_meta_interleaved_cuts_the_bubble():
+    plain = schedule_meta("1f1b", pipe=4, microbatches=8)
+    inter = schedule_meta("interleaved", pipe=4, microbatches=8, virtual=2)
+    # v=2: ticks = M·v + v·P + P - 2, useful = M·v
+    assert inter["ticks"] == 8 * 2 + 2 * 4 + 4 - 2
+    assert inter["useful_ticks"] == 16
+    # the tentpole claim, in schedule arithmetic: interleaving shrinks the
+    # bubble FRACTION at fixed (P, M) — per-tick work also shrinks ~v×,
+    # so the bubble TIME shrinks even further
+    assert inter["bubble_frac"] < plain["bubble_frac"]
+    deeper = schedule_meta("interleaved", pipe=4, microbatches=8, virtual=4)
+    assert deeper["bubble_frac"] < inter["bubble_frac"]
+
+
+def test_schedule_meta_gpipe_and_unknown():
+    g = schedule_meta("gpipe", pipe=4, microbatches=12)
+    assert g["ticks"] == 12 + 3 and g["useful_ticks"] == 12
+    # gpipe ignores virtual (single contiguous slice per stage)
+    assert schedule_meta("gpipe", 4, 12, virtual=3)["virtual"] == 1
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        schedule_meta("zigzag", 4, 12)
+
+
+# -------------------------------------------------- actionable refusals
+
+
+def test_microbatch_help_names_legal_counts():
+    msg = microbatch_help(64, 5, 2)
+    assert "64" in msg and "legal microbatch counts" in msg
+    # legal m: 64 % (m*2) == 0 → ..., 8, 16, 32
+    assert "32" in msg
+    inter = microbatch_help(64, 6, 2, pipe=4)
+    assert "multiple of the stage count 4" in inter
+
+
+def test_pipeline_help_names_legal_degrees():
+    msg = pipeline_help(8, 3, 2)
+    assert "depth 8" in msg and "virtual=2" in msg
+    # legal P at v=2: depth % (P*2) == 0 → 1, 2, 4
+    assert "[1, 2, 4]" in msg
+
+
+def test_validate_reshard_refuses_illegal_pipe_axis():
+    class FakeMesh:
+        shape = {"data": 2, "model": 1, "pipe": 3}
+
+    with pytest.raises(ReshardError, match="legal --pipeline-parallel"):
+        validate_reshard(
+            {"mesh": {"data": 4, "model": 1, "pipe": 2}},
+            FakeMesh(),
+            batch_size=64,
+            pipeline={"pipe": 3, "virtual": 1, "microbatches": 4, "depth": 8},
+        )
+
+
+def test_validate_reshard_refuses_indivisible_microbatches():
+    class FakeMesh:
+        shape = {"data": 4, "model": 1, "pipe": 2}
+
+    with pytest.raises(ReshardError, match="legal microbatch counts"):
+        validate_reshard(
+            None,
+            FakeMesh(),
+            batch_size=64,
+            pipeline={"pipe": 2, "virtual": 1, "microbatches": 6, "depth": 8},
+        )
+
+
+def test_validate_reshard_records_pipe_delta():
+    class FakeMesh:
+        shape = {"data": 2, "model": 1, "pipe": 2}
+
+    plan = validate_reshard(
+        {"mesh": {"data": 4, "model": 1, "pipe": 4}, "devices": 16},
+        FakeMesh(),
+        batch_size=64,
+        pipeline={"pipe": 2, "virtual": 2, "microbatches": 4, "depth": 8},
+    )
+    assert plan["changed"]
+    assert plan["saved_pipe"] == 4 and plan["pipe"] == 2
+    assert plan["pipe_changed"]
+
+
+def test_config_rejects_bad_pipeline_combos(tmp_path):
+    with pytest.raises(SystemExit):
+        load_config("tpu", argv=["--pipeline-parallel", "0"])
+    with pytest.raises(SystemExit):
+        load_config(
+            "tpu",
+            argv=["--pipeline-virtual-stages", "2"],  # needs interleaved
+        )
+    with pytest.raises(SystemExit):
+        load_config(
+            "tpu",
+            argv=["--pipeline-parallel", "2", "--parallel-style", "pipeline"],
+        )
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--pipeline-parallel", "2", "--pipeline-schedule", "interleaved",
+            "--pipeline-virtual-stages", "2",
+        ],
+    )
+    assert hp.pipeline_parallel == 2 and hp.pipeline_virtual_stages == 2
+
+
+# ------------------------------------------- per-stage desync fingerprints
+
+
+def test_check_partial_desync_cube_names_the_stage():
+    # (data=2, model=2, pipe=3) cube: in-sync everywhere except stage 2
+    cube = np.ones((2, 2, 3), np.float64)
+    cube[1, 0, 2] += 0.25
+    report = check_partial_desync(cube)
+    assert report["mismatch"]
+    assert report["per_stage_spread"] == [0.0, 0.0, 0.25]
+    clean = check_partial_desync(np.ones((2, 2, 3)))
+    assert not clean["mismatch"]
+    assert clean.get("per_stage_spread", [0, 0, 0]) == [0.0, 0.0, 0.0]
+
+
+def test_check_partial_desync_2d_matrix_unchanged():
+    m = np.ones((4, 2))
+    m[3, 1] += 0.5
+    report = check_partial_desync(m)
+    assert report["mismatch"] and "per_stage_spread" not in report
+    assert report["per_model_spread"] == [0.0, 0.5]
+
+
+# ------------------------------------------- per-stage straggler sketches
+
+
+def _metrics_event(proc, metrics, attempt=0):
+    return {
+        "v": 1, "run_id": "r", "attempt": attempt, "process_index": proc,
+        "t_wall": 1.0, "t_mono": 1.0, "kind": "metrics",
+        "payload": {"metrics": metrics},
+    }
+
+
+def _hist(samples):
+    """A sketch snapshot in the merge format (obs/metrics.py)."""
+    from distributed_training_comparison_tpu.obs.metrics import Histogram
+
+    h = Histogram("test")
+    for s in samples:
+        h.record(s)
+    return h.snapshot()
+
+
+def test_straggler_findings_gain_stage_dimension():
+    # two hosts each owning one pipeline stage; host 1's stage sketch is
+    # 10x slower — the finding must name phase stage1 AND carry stage=1
+    fast = _hist([0.1] * 8)
+    slow = _hist([1.0] * 8)
+    events = [
+        _metrics_event(0, {"step/stage0/busy_s": fast}),
+        _metrics_event(1, {"step/stage1/busy_s": fast}),
+    ] * 2 + [
+        _metrics_event(0, {"step/stage0/busy_s": fast}),
+        _metrics_event(1, {"step/stage1/busy_s": slow}),
+    ]
+    # cross-host comparison happens per phase; put both hosts on BOTH
+    # stage phases so the leave-one-out baseline exists
+    events += [
+        _metrics_event(0, {"step/stage1/busy_s": fast}),
+        _metrics_event(1, {"step/stage0/busy_s": fast}),
+    ]
+    findings = straggler.straggler_findings(events, threshold_mads=3.0)
+    stage_findings = [f for f in findings if f["phase"].startswith("stage")]
+    assert stage_findings, "no stage-phase finding produced"
+    worst = stage_findings[0]
+    assert worst["process_index"] == 1
+    assert worst["phase"] == "stage1"
+    assert worst["stage"] == 1
+    # the table renders the stage columns and marks the straggler
+    lines = straggler.format_table(events)
+    assert any("stage1" in line for line in lines)
+    assert any("pipeline stage 1" in line for line in lines)
+
+
+def test_straggler_plain_phases_unchanged():
+    fast = _hist([0.1] * 8)
+    slow = _hist([2.0] * 8)
+    events = [
+        _metrics_event(0, {"step/dispatch_s": fast}),
+        _metrics_event(1, {"step/dispatch_s": slow}),
+    ]
+    findings = straggler.straggler_findings(events, threshold_mads=3.0)
+    assert findings and findings[0]["phase"] == "dispatch"
+    assert "stage" not in findings[0]
+
+
+# --------------------------------------------- run_report bubble table
+
+
+def _pipeline_event(**payload):
+    base = dict(
+        schedule="interleaved", pipe=2, virtual=2, microbatches=4,
+        tp=2, data=2, ticks=14, useful_ticks=8, bubble_frac=0.4286,
+        depth=8,
+    )
+    base.update(payload)
+    return {
+        "v": 1, "run_id": "r", "attempt": 0, "process_index": 0,
+        "t_wall": 1.0, "t_mono": 1.0, "kind": "pipeline", "payload": base,
+    }
+
+
+def _compile_event(name, fp):
+    return {
+        "v": 1, "run_id": "r", "attempt": 0, "process_index": 0,
+        "t_wall": 1.0, "t_mono": 1.0, "kind": "compile",
+        "payload": {
+            "name": name, "fingerprint": fp, "compile_s": 0.5,
+            "cache": "miss", "flops": 1e9,
+        },
+    }
+
+
+def test_run_report_pipeline_bubble_table():
+    disp = _hist([0.5] * 4)
+    events = [
+        _pipeline_event(),
+        _compile_event("device_chunk_runner@k2", "abcd1234"),
+        _compile_event("eval_runner", "ffff0000"),
+        _metrics_event(
+            0, {"exec/device_chunk_runner@k2:abcd1234/dispatch_s": disp}
+        ),
+    ]
+    comp = run_report.compute_summary(events)
+    pipe = comp["pipeline"]
+    assert pipe["meta"]["schedule"] == "interleaved"
+    rows = pipe["rows"]
+    assert len(rows) == 1  # eval_runner carries no bubble
+    row = rows[0]
+    assert row["name"] == "device_chunk_runner@k2"
+    assert row["bubble_frac"] == pytest.approx(0.4286)
+    assert row["bubble_s"] == pytest.approx(2.0 * 0.4286, rel=1e-3)
+    text = run_report.format_compute(comp)
+    assert "bubble" in text and "interleaved" in text
+    # the summary path renders the same section
+    summary = run_report.format_summary("x", run_report.summarize(events))
+    assert "schedule=interleaved" in summary
+
+
+def test_run_report_without_pipeline_event_unchanged():
+    events = [_compile_event("device_chunk_runner@k2", "abcd1234")]
+    comp = run_report.compute_summary(events)
+    assert "pipeline" not in comp
+
+
+# --------------------------------------------- synthetic (host,stage) lanes
+
+
+def test_span_recorder_record_makes_stage_lanes():
+    rec = obs.SpanRecorder(process_index=0)
+    rec.record("pp_busy", 1.0, 2.0, lane="stage0", stage=0, bubble_frac=0.3)
+    rec.record("pp_fill_bubble", 1.0, 1.2, lane="stage1", stage=1)
+    with rec.span("epoch"):  # a real thread span coexists
+        pass
+    trace = obs.chrome_trace(rec.spans(), 0)
+    names = {
+        (e.get("args") or {}).get("name")
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    assert {"stage0", "stage1"} <= names
+    busy = next(
+        e for e in trace["traceEvents"] if e.get("name") == "pp_busy"
+    )
+    assert busy["dur"] == pytest.approx(1e6)  # µs
+    assert busy["args"]["bubble_frac"] == 0.3
+    # the two lanes get distinct stable pseudo thread ids
+    tids = {
+        e["tid"]
+        for e in trace["traceEvents"]
+        if e.get("name", "").startswith("pp_")
+    }
+    assert len(tids) == 2
+
+
+def test_pipeline_event_kind_registered_and_accepted():
+    assert "pipeline" in obs.KNOWN_KINDS

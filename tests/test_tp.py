@@ -91,7 +91,9 @@ def test_batch_stats_specs_follow_bn_params():
 @pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
 def test_params_actually_partitioned(mesh_shape):
     mesh = parallel.make_mesh(8, mesh_shape[1], backend="tpu")
-    assert dict(mesh.shape) == {"data": mesh_shape[0], "model": mesh_shape[1]}
+    assert dict(mesh.shape) == {
+        "data": mesh_shape[0], "model": mesh_shape[1], "pipe": 1,
+    }
     state = _make_state()
     placed, _ = _placed(mesh, state)
 

@@ -509,6 +509,12 @@ def format_summary(name: str, s: dict) -> str:
             )
         )
     lines.extend(s.get("straggler_lines") or [])
+    pipe = (s.get("compute") or {}).get("pipeline")
+    if pipe is not None:
+        # the pipeline section: schedule arithmetic + the measured
+        # per-executable bubble table (one line each — --compute has the
+        # full cost/memory context)
+        lines.extend(format_pipeline(pipe["meta"], pipe["rows"]))
     # stall calls condense to one line per process (counts per state +
     # the final state) — a run whose heartbeat cadence undershoots its
     # chunk time can transition hundreds of times, and the echo must not
@@ -868,6 +874,84 @@ def export_openmetrics(path: str | Path, out_path: str | None = None) -> str:
 # host time anyway, see the README caveat).
 
 
+def pipeline_meta(events: list[dict]) -> dict | None:
+    """The latest ``pipeline`` event's payload (one per attempt, emitted by
+    the Trainer when a pipeline schedule is active): the schedule's static
+    tick arithmetic — ticks, useful ticks, bubble fraction, virtual
+    stages."""
+    meta = None
+    for ev in events:
+        if ev.get("kind") == "pipeline" and int(ev.get("process_index", 0)) == 0:
+            meta = _payload(ev)
+    return meta
+
+
+# executable-name prefixes that dispatch the pipeline schedule (the train
+# runners); eval/snapshot/fingerprint programs carry no bubble
+_PIPELINE_EXEC_PREFIXES = (
+    "device_chunk_runner", "chunk_runner", "epoch_runner", "train_step",
+)
+
+
+def pipeline_bubble_rows(comp: dict, meta: dict) -> list[dict]:
+    """Join the schedule's static bubble fraction against each train
+    executable's MEASURED dispatch seconds: ``bubble_s`` is the wall time
+    that executable spent in warmup/cooldown ticks (computed, on real
+    silicon lockstepped, but discarded).  The schedule arithmetic supplies
+    the fraction; the dispatch sketches supply the seconds."""
+    frac = float(meta.get("bubble_frac", 0.0))
+    rows = []
+    for row in comp.get("rows", []):
+        if not str(row.get("name", "")).startswith(_PIPELINE_EXEC_PREFIXES):
+            continue
+        if not row.get("dispatches"):
+            continue
+        span_s = row.get("dispatch_s", 0.0) + row.get("drain_s", 0.0)
+        rows.append(
+            {
+                "name": row["name"],
+                "fingerprint": row["fingerprint"],
+                "dispatches": row["dispatches"],
+                "span_s": round(span_s, 4),
+                "bubble_frac": frac,
+                "bubble_s": round(span_s * frac, 4),
+            }
+        )
+    return rows
+
+
+def format_pipeline(meta: dict, rows: list[dict]) -> list[str]:
+    """The pipeline section lines: schedule arithmetic + the measured
+    per-executable bubble table."""
+    lines = [
+        "  pipeline: schedule={schedule} P={pipe} virtual={virtual} "
+        "M={microbatches} tp={tp} ticks={ticks} useful={useful_ticks} "
+        "bubble={frac:.1%}".format(
+            frac=float(meta.get("bubble_frac", 0.0)),
+            **{
+                k: meta.get(k, "?")
+                for k in (
+                    "schedule", "pipe", "virtual", "microbatches", "tp",
+                    "ticks", "useful_ticks",
+                )
+            },
+        )
+    ]
+    if rows:
+        header = (
+            f"    {'executable':<28} {'dispatches':>10} {'span':>9} "
+            f"{'bubble':>7} {'bubble_s':>9}"
+        )
+        lines.append(header)
+        for r in rows:
+            lines.append(
+                f"    {r['name']:<28} {r['dispatches']:>10}"
+                f" {r['span_s']:>8.2f}s {r['bubble_frac']:>6.1%}"
+                f" {r['bubble_s']:>8.2f}s"
+            )
+    return lines
+
+
 def compute_summary(events: list[dict], peak_override: float | None = None) -> dict:
     """Fold a merged stream's ``compile`` events + exec dispatch sketches
     into per-executable rows (process-0 events only, like every other
@@ -979,12 +1063,21 @@ def compute_summary(events: list[dict], peak_override: float | None = None) -> d
     census = merged.get("res/live_array_bytes")
     if census is not None:
         totals["live_array_bytes"] = census.get("value")
-    return {
+    comp = {
         "rows": sorted(
             rows.values(), key=lambda r: (r["name"], r["fingerprint"])
         ),
         "totals": totals,
     }
+    # pipeline runs: join the schedule's static bubble fraction against
+    # the measured dispatch seconds — the per-executable bubble table
+    meta = pipeline_meta(events)
+    if meta is not None:
+        comp["pipeline"] = {
+            "meta": meta,
+            "rows": pipeline_bubble_rows(comp, meta),
+        }
+    return comp
 
 
 def _fmt_bytes(n) -> str:
@@ -1056,6 +1149,9 @@ def format_compute(comp: dict) -> str:
             f"  live-array census (res/live_array_bytes, last sample): "
             f"{_fmt_bytes(t['live_array_bytes'])}"
         )
+    pipe = comp.get("pipeline")
+    if pipe is not None:
+        lines.extend(format_pipeline(pipe["meta"], pipe["rows"]))
     return "\n".join(lines)
 
 
