@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import time
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -747,6 +748,70 @@ def events_check_rc(ckpt_root: str, require_kinds=()) -> int:
     return subprocess.run(cmd).returncode
 
 
+def _drive_fleet_gauntlet(
+    ckpt_root: str, proc, driver_log: list, readmit: bool,
+    timeout: float = 600.0,
+) -> None:
+    """The external environment's script, shared by the resilience and
+    chaos legs: SIGKILL host 1 (spot reclaim) once attempt 0 has a
+    verified checkpoint, and — with ``readmit`` — write ``host-1.up``
+    (the SCHEDULER's re-admission interface) once the shrunk attempt's
+    ``run_start`` lands.  Never an operator action: no ``host-i.down``
+    is ever written here."""
+    import os
+    import signal as _signal
+    import time as _time
+
+    from distributed_training_comparison_tpu.resilience import read_manifest
+
+    status_path = os.path.join(ckpt_root, "fleet", "status.json")
+    events_path = os.path.join(ckpt_root, "version-0", "events.jsonl")
+
+    def status():
+        with open(status_path) as f:
+            return json.load(f)
+
+    def wait(cond, what) -> bool:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if proc.poll() is not None:
+                driver_log.append(f"fleet exited before {what}")
+                return False
+            try:
+                if cond():
+                    return True
+            except (OSError, ValueError, KeyError):
+                pass
+            _time.sleep(0.05)
+        driver_log.append(f"timed out waiting for {what}")
+        return False
+
+    if not wait(
+        lambda: status()["attempt"] == 0
+        and read_manifest(
+            os.path.join(ckpt_root, "version-0", "last.ckpt")
+        ) is not None,
+        "attempt 0 checkpoint",
+    ):
+        return
+    os.kill(int(status()["pids"]["1"]), _signal.SIGKILL)
+    driver_log.append("spot-reclaimed host 1 (SIGKILL)")
+    if not readmit:
+        return
+    if not wait(
+        lambda: status()["attempt"] == 1
+        and any(
+            '"kind": "run_start"' in line and '"attempt": 1' in line
+            for line in open(events_path).read().splitlines()
+        ),
+        "attempt 1 run_start",
+    ):
+        return
+    with open(os.path.join(ckpt_root, "fleet", "host-1.up"), "w"):
+        pass
+    driver_log.append("scheduler re-admitted host 1 (host-1.up)")
+
+
 def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     """The resilience leg: the ELASTIC-POOL gauntlet (ISSUE 10) — a real
     supervised 2-host fleet run through ``--supervise --fleet-hosts 2``
@@ -776,12 +841,10 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     rendezvousing via ``init_distributed``.
     """
     import os
-    import signal as _signal
     import subprocess
     import sys
     import tempfile
     import threading
-    import time as _time
 
     platform = jax.devices()[0].platform
     repo = os.path.dirname(os.path.abspath(__file__))
@@ -815,56 +878,12 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
         "--goodput-json", out_path,
     ]
 
-    status_path = os.path.join(ckpt_root, "fleet", "status.json")
-    events_path = os.path.join(ckpt_root, "version-0", "events.jsonl")
     driver_log: list = []
 
-    def _wait(cond, what, proc, timeout=600.0) -> bool:
-        deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
-            if proc.poll() is not None:
-                driver_log.append(f"fleet exited before {what}")
-                return False
-            try:
-                if cond():
-                    return True
-            except (OSError, ValueError, KeyError):
-                pass
-            _time.sleep(0.05)
-        driver_log.append(f"timed out waiting for {what}")
-        return False
-
     def drive(proc) -> None:
-        """The gauntlet's fault script: kill host 1 once attempt 0 has a
-        verified checkpoint; re-admit it once the shrunk attempt is up."""
-        from distributed_training_comparison_tpu.resilience import read_manifest
-
-        def status():
-            with open(status_path) as f:
-                return json.load(f)
-
-        if not _wait(
-            lambda: status()["attempt"] == 0
-            and read_manifest(
-                os.path.join(ckpt_root, "version-0", "last.ckpt")
-            ) is not None,
-            "attempt 0 checkpoint", proc,
-        ):
-            return
-        os.kill(int(status()["pids"]["1"]), _signal.SIGKILL)
-        driver_log.append("killed host 1")
-        if not _wait(
-            lambda: status()["attempt"] == 1
-            and any(
-                '"kind": "run_start"' in line and '"attempt": 1' in line
-                for line in open(events_path).read().splitlines()
-            ),
-            "attempt 1 run_start", proc,
-        ):
-            return
-        with open(os.path.join(ckpt_root, "fleet", "host-1.up"), "w"):
-            pass
-        driver_log.append("re-admitted host 1")
+        # kill host 1 once attempt 0 has a verified checkpoint; re-admit
+        # it once the shrunk attempt is up (shared with the chaos leg)
+        _drive_fleet_gauntlet(ckpt_root, proc, driver_log, readmit=True)
 
     proc = subprocess.Popen(
         cmd, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -923,6 +942,256 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
         "platform": platform,
         "full_record": out_path,
     }))
+    return record
+
+
+def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
+    """The chaos gauntlet (ISSUE 13): run every named scenario of
+    ``resilience.faults.CHAOS_SCENARIOS`` — preempt x straggler-stall x
+    corrupt-shard (nan_grad) x host-flap, alone and composed — end-to-end
+    under the fleet supervisor with the closed-loop policy engine active,
+    and commit the scoreboard as ``CHAOS.json`` the way GOODPUT.json
+    prices the kill->shrink->readmit->expand run.
+
+    Every scenario must recover via policy/supervisor actions alone: no
+    operator marker files (the only marker a driver writes is
+    ``host-1.up`` — the SCHEDULER's re-admission interface, exactly as in
+    the GOODPUT gauntlet).  Each run self-validates its event stream
+    (``run_report --check`` plus the scenario's required kinds — the
+    policy scenarios require ``policy``), its expectations are checked by
+    ``check_chaos_expectations`` (a violated scenario fails the leg), and
+    no policy action may end the gauntlet still pending
+    (``run_report --policy`` semantics).
+
+    CPU emulation caveat (same as the resilience leg): rank 1 is the
+    pid+event-file host emulation from ``tests/fleet_pool_worker.py`` —
+    the pinned CI jax cannot run multi-process collectives on the CPU
+    backend — and the persistent straggler is that rank reporting a
+    slowed ``step/dispatch_s`` sketch (``EMU_SLOW_DISPATCH_ENV``), which
+    is exactly the interface a genuinely slow host presents to the
+    supervisor-side alert engine.
+    """
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    from distributed_training_comparison_tpu import obs
+    from distributed_training_comparison_tpu.resilience import (
+        CHAOS_KIND,
+        CHAOS_SCENARIOS,
+        check_chaos_expectations,
+    )
+    from distributed_training_comparison_tpu.ops.policy import pending_actions
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"
+    ))
+    import run_report
+
+    platform = jax.devices()[0].platform
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child = os.path.join(repo, "tests", "fleet_pool_worker.py")
+    names = list(scenarios or CHAOS_SCENARIOS)
+    rows: dict[str, dict] = {}
+    failures: list[str] = []
+    worst_rc = 0
+
+    for name in names:
+        sc = CHAOS_SCENARIOS[name]
+        root = tempfile.mkdtemp(prefix=f"chaos-{name}-")
+        goodput_json = os.path.join(root, "goodput-scenario.json")
+        cmd = [
+            sys.executable, child, "--supervise",
+            "--fleet-hosts", "2", "--fleet-local-devices", "1",
+            "--fleet-grace-secs", "3", "--fleet-poll-secs", "0.2",
+            "--synthetic-data", "--limit-examples", "256",
+            "--batch-size", "32", "--epoch", "10",
+            "--no-progress", "--eval-step", "1000",
+            "--save-last-min-secs", "0", "--seed", "7",
+            "--device-chunk-steps", "2", "--heartbeat-secs", "0.2",
+            "--ckpt-path", root, "--goodput-json", goodput_json,
+            "--policy-mode", sc["policy_mode"],
+        ]
+        if sc["fault_plan"]:
+            cmd += ["--fault-plan", sc["fault_plan"]]
+        for spec in sc["alerts"]:
+            cmd += ["--alert", spec]
+        for spec in sc["policies"]:
+            cmd += ["--policy", spec]
+        cmd += list(sc["extra_args"])
+        env = dict(os.environ)
+        env.update(sc["env"])
+
+        driver_log: list = []
+
+        def drive(proc, script=sc["driver"]) -> None:
+            # the external environment only: spot reclaim (SIGKILL) and
+            # the scheduler's re-admission marker — never an operator
+            # action (no host-i.down is ever written here)
+            if script is not None:
+                _drive_fleet_gauntlet(
+                    root, proc, driver_log,
+                    readmit=script == "kill_and_readmit_host1",
+                )
+
+        proc = subprocess.Popen(
+            cmd, cwd=repo, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            # own process group: a timeout kill must take the supervised
+            # fleet's rank children down too, not orphan them onto the
+            # next scenario's timings
+            start_new_session=True,
+        )
+        driver = threading.Thread(target=drive, args=(proc,), daemon=True)
+        driver.start()
+        timed_out = False
+        try:
+            out, err = proc.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            # a wedged scenario must neither leak its process tree nor
+            # abort the gauntlet: kill the whole group, record a red
+            # row, move on
+            timed_out = True
+            import signal as _signal
+
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
+            out, err = proc.communicate()
+            driver_log.append("scenario timed out after 900s; killed")
+        driver.join(timeout=10.0)
+
+        events, _files = run_report.load_run(root)
+        by_kind: dict[str, int] = {}
+        for ev in events:
+            by_kind[ev.get("kind", "?")] = by_kind.get(ev.get("kind", "?"), 0) + 1
+        policy_states: dict[str, int] = {}
+        for ev in events:
+            if ev.get("kind") == "policy":
+                st = (ev.get("payload") or {}).get("state", "?")
+                policy_states[st] = policy_states.get(st, 0) + 1
+        try:
+            with open(goodput_json) as f:
+                gp = json.load(f)
+        except (OSError, ValueError):
+            gp = {}
+        evidence_ok = False
+        for dump in sorted(Path(root).glob("version-*/crash_dump*.json")):
+            try:
+                d = json.loads(dump.read_text())
+            except (OSError, ValueError):
+                continue
+            ev_block = d.get("evidence") or {}
+            if ev_block.get("alert_timeline") and ev_block.get("policy_timeline"):
+                evidence_ok = True
+        observed = {
+            "final_rc": proc.returncode,
+            "resizes": by_kind.get("resize", 0),
+            "rollbacks": by_kind.get("rollback", 0),
+            "alerts_fired": sum(
+                1 for ev in events
+                if ev.get("kind") == "alert"
+                and (ev.get("payload") or {}).get("state") == "firing"
+            ),
+            "restarts": int(gp.get("restarts", 0) or 0),
+            "preemptions": int(gp.get("preemptions", 0) or 0),
+            "policy_requested": policy_states.get("requested", 0),
+            "policy_completed": policy_states.get("completed", 0),
+            "policy_failed": policy_states.get("failed", 0),
+            "policy_dry_run": policy_states.get("dry_run", 0),
+            "policy_cooldown": policy_states.get("cooldown", 0),
+            "policy_budget": policy_states.get("budget", 0),
+            "policy_pending": len(pending_actions(events)),
+            "crash_dump_evidence": evidence_ok,
+            "goodput_frac": gp.get("goodput_frac"),
+        }
+        problems = check_chaos_expectations(sc["expect"], observed)
+        if timed_out:
+            problems.append("scenario timed out after 900s (process killed)")
+        if observed["policy_pending"]:
+            problems.append(
+                f"{observed['policy_pending']} policy action(s) still "
+                "pending (requested, never completed)"
+            )
+        check_rc = events_check_rc(
+            root, require_kinds=tuple(sc["require_kinds"])
+        )
+        worst_rc = max(worst_rc, check_rc)
+        if check_rc != 0:
+            problems.append(f"events_check_rc={check_rc}")
+        row = {
+            "desc": sc["desc"],
+            "fault_plan": sc["fault_plan"],
+            "alerts": list(sc["alerts"]),
+            "policies": list(sc["policies"]),
+            "policy_mode": sc["policy_mode"],
+            "driver": driver_log,
+            **observed,
+            "events_check_rc": check_rc,
+            "green": not problems,
+            "problems": problems,
+        }
+        rows[name] = row
+        emit_progress(f"chaos/{name}", {
+            "rc": proc.returncode, "green": row["green"],
+            "problems": problems, "policy": policy_states,
+        })
+        if problems:
+            failures.append(
+                f"{name}: {problems} (stderr tail: {(err or '')[-800:]})"
+            )
+        # one `chaos` event per scenario on a bus bound to the scenario
+        # root, so the scoreboard row itself is replayable from the stream
+        chaos_bus = obs.EventBus(run_id=obs.new_run_id())
+        chaos_bus.bind_dir(root)
+        chaos_bus.emit(
+            CHAOS_KIND, scenario=name, green=row["green"],
+            policy_completed=observed["policy_completed"],
+            resizes=observed["resizes"], rollbacks=observed["rollbacks"],
+            final_rc=observed["final_rc"],
+        )
+        chaos_bus.close()
+
+    record = {
+        "metric": "chaos_matrix",
+        "platform": platform,
+        "scenarios": rows,
+        "green": not failures,
+        "events_check_rc": worst_rc,
+        "note": (
+            "CPU capture: rank 1 is the pid+event-file host emulation "
+            "(tests/fleet_pool_worker.py) and the persistent straggler is "
+            "its slowed step/dispatch_s sketch; every supervisor/policy "
+            "code path (alert evaluation, drain markers, request channel, "
+            "world re-render) runs for real. Recovery is policy/supervisor"
+            "-driven only — the single driver-written marker is host-1.up, "
+            "the scheduler's re-admission interface."
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "chaos_matrix",
+        "green": record["green"],
+        "scenarios": {
+            n: {
+                "green": r["green"], "final_rc": r["final_rc"],
+                "policy_completed": r["policy_completed"],
+                "resizes": r["resizes"], "rollbacks": r["rollbacks"],
+                "goodput_frac": r["goodput_frac"],
+            }
+            for n, r in rows.items()
+        },
+        "full_record": out_path,
+    }))
+    if failures:
+        raise RuntimeError(
+            "chaos gauntlet red: " + "; ".join(failures)
+        )
     return record
 
 
@@ -2206,6 +2475,8 @@ if __name__ == "__main__":
         bench_serve()
     elif "--resilience" in sys.argv:
         bench_resilience()
+    elif "--chaos" in sys.argv:
+        bench_chaos()
     elif "--health" in sys.argv:
         bench_health()
     elif "--overlap" in sys.argv:

@@ -768,6 +768,49 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "into a timeline and a CI exit code",
     )
     parser.add_argument(
+        "--policy",
+        action="append",
+        default=None,
+        metavar="SPEC",
+        help="Closed-loop autopilot rule, repeatable: 'ALERT -> ACTION"
+        "[:cooldown=S]' binds a firing --alert rule (matched by its full "
+        "spec or its metric name) to an action — drain_host (write the "
+        "same <ckpt>/fleet/host-i.down marker an operator writes today), "
+        "rewarm_serve (re-run warmup() on the recompiled bucket subset), "
+        "rollback (the watchdog's verified-restore path), or "
+        "abort_with_evidence (orderly abort with the blackbox ring + "
+        "alert/policy timelines in crash_dump.json, and the supervisor "
+        "stops relaunching).  Example: 'step/dispatch_s:p95>30:for=2 -> "
+        "drain_host:cooldown=120'.  Every decision emits a 'policy' "
+        "event; per-rule cooldowns (default 60s) and --policy-max-actions "
+        "bound what a flapping alert can drive.  Evaluated wherever the "
+        "alerts are: supervisor-side for supervised runs, in-process "
+        "otherwise.  See ops/policy.py and run_report --policy",
+    )
+    parser.add_argument(
+        "--policy-mode",
+        type=str,
+        default="dry-run",
+        choices=["off", "dry-run", "act"],
+        help="Autopilot mode: 'dry-run' (default) makes every decision — "
+        "cooldowns and budget advance exactly as they would — and logs "
+        "what it WOULD have done without running any action; 'act' runs "
+        "them; 'off' disables the engine entirely.  The runbook is: "
+        "watch a dry-run's policy timeline, then flip to act",
+    )
+    parser.add_argument(
+        "--policy-max-actions",
+        type=int,
+        default=4,
+        metavar="N",
+        help="Global actions-per-attempt budget for the policy engine: "
+        "at most N decisions act (or dry-run-log) per supervised "
+        "attempt, so an alert storm cannot drain the whole fleet in one "
+        "attempt.  The budget re-grants at every attempt start (and on "
+        "a 15-minute clock in attempt-less sessions — serving must "
+        "rate-limit re-warms, not lose them forever)",
+    )
+    parser.add_argument(
         "--health-phase-baselines",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -886,14 +929,33 @@ def load_config(
         parser.error(
             f"--metrics-port must be in [0, 65535], got {args.metrics_port}"
         )
+    alert_rules = []
     if args.alert:
         # a malformed alert rule must die at the CLI, not at the first
         # flush of a run that already burned its startup/compile time
         from .obs.alerts import AlertSpecError, parse_alert_specs
 
         try:
-            parse_alert_specs(args.alert)
+            alert_rules = parse_alert_specs(args.alert)
         except AlertSpecError as e:
+            parser.error(str(e))
+    if args.policy_max_actions < 1:
+        parser.error(
+            f"--policy-max-actions must be >= 1, got {args.policy_max_actions}"
+        )
+    if args.policy:
+        # same contract as --alert/--fault-plan: a malformed policy rule
+        # (or one whose trigger names no alert rule and thus can never
+        # fire) dies at the CLI, not in a post-mortem
+        from .ops.policy import (
+            PolicySpecError,
+            parse_policy_specs,
+            validate_policy_rules,
+        )
+
+        try:
+            validate_policy_rules(parse_policy_specs(args.policy), alert_rules)
+        except PolicySpecError as e:
             parser.error(str(e))
     if args.fault_plan:
         # a malformed fault plan must die at the CLI, not at epoch 0 of a

@@ -83,6 +83,13 @@ KNOWN_KINDS = {
     # fraction, virtual stages) — run_report joins it with the measured
     # dispatch sketches into the per-executable bubble table
     "pipeline",
+    # closed-loop autopilot (ops/policy): one event per policy decision —
+    # rule, triggering alert, action, cooldown/budget state, dry-run flag
+    # — whether the action ran, deferred, or was suppressed
+    "policy",
+    # chaos gauntlet (resilience/faults scenario catalog + bench --chaos):
+    # one event per named scenario with its outcome counts
+    "chaos",
 }
 
 
@@ -349,11 +356,17 @@ class EventBus:
         reason: str,
         exc: BaseException | None = None,
         directory: str | Path | None = None,
+        evidence: dict | None = None,
     ) -> Path | None:
         """Write ``crash_dump.json`` — the final ring of events plus the
         triggering reason/traceback — into ``directory`` (default: the
         bound event dir).  Returns the path, or None when there is nowhere
         to write.  Never raises.
+
+        ``evidence`` (optional) lands under the dump's ``"evidence"`` key:
+        the policy engine's ``abort_with_evidence`` attaches the alert and
+        policy timelines here, so the post-mortem opens on WHY the run was
+        stopped, not just its final ring.
 
         Idempotent per bus: the FIRST dump wins — an in-flight abort dumps
         with its specific reason, and the entry point's unhandled-exception
@@ -372,6 +385,8 @@ class EventBus:
             "reason": str(reason),
             "ring": self.ring_events(),
         }
+        if evidence:
+            dump["evidence"] = evidence
         if exc is not None:
             dump["exception"] = {
                 "type": type(exc).__name__,

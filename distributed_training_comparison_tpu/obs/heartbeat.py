@@ -47,6 +47,11 @@ STALL_KIND = "stall"
 _NON_LIVENESS_KINDS = {
     STALL_KIND, "straggler", "alert",
     "attempt_start", "attempt_end", "backoff", "give_up", "run_summary",
+    # the autopilot's decisions and the chaos driver's scenario stamps are
+    # watcher/driver-side too: a policy event about draining host i must
+    # never count as a sign of life from the process it names (the same
+    # self-revival flap the supervisor's own stall events once caused)
+    "policy", "chaos",
 }
 
 # liveness thresholds as multiples of the heartbeat cadence: a beat is
@@ -346,10 +351,15 @@ class FleetWatcher:
     processes, so a wedged collective cannot take its own monitoring down
     with it.
 
-    ``tracker`` / ``engine`` are optional: a watcher with neither still
-    tails (e.g. to keep the exporter's fleet state fresh).  ``start`` /
-    ``stop`` bracket one supervised run; ``step()`` runs one poll cycle
-    synchronously (tests drive it with a fake clock).
+    ``tracker`` / ``engine`` / ``policy`` are optional: a watcher with
+    none still tails (e.g. to keep the exporter's fleet state fresh).
+    ``policy`` (a :class:`~..ops.policy.PolicyEngine`) sees every tailed
+    event — including the ``alert`` events the engine emits onto the
+    supervisor's own bus, which land in the tailed root file one poll
+    later — so alert firings drive actions through ONE delivery path
+    with no double-count.  ``start`` / ``stop`` bracket one supervised
+    run; ``step()`` runs one poll cycle synchronously (tests drive it
+    with a fake clock).
 
     The poll is **adaptive**: ``poll_s`` (the ``--fleet-poll-secs`` knob)
     is the steady-state cadence, but while any tracked host is in a
@@ -367,6 +377,7 @@ class FleetWatcher:
         bus,
         tracker: LivenessTracker | None = None,
         engine=None,
+        policy=None,
         poll_s: float = 1.0,
         fast_poll_s: float | None = None,
     ) -> None:
@@ -374,6 +385,7 @@ class FleetWatcher:
         self.bus = bus
         self.tracker = tracker
         self.engine = engine
+        self.policy = policy
         self.poll_s = float(poll_s)
         self.fast_poll_s = min(
             self.poll_s,
@@ -400,6 +412,8 @@ class FleetWatcher:
                 self.tracker.observe(ev, now=now)
             if self.engine is not None:
                 self.engine.observe_event(ev)
+            if self.policy is not None:
+                self.policy.observe_event(ev)
         if self.tracker is not None:
             for finding in self.tracker.check(now=now):
                 self.bus.emit(

@@ -12,6 +12,7 @@ that owns its hot ops" is Pallas: each op here ships
 Dispatch helpers pick the kernel on TPU and the reference elsewhere.
 """
 
+from . import policy  # noqa: F401  (closed-loop autopilot; stdlib-only)
 from .attention import attention, flash_attention, mha_reference
 from .attention_small import small_mha
 from .moe_gmm import grouped_ffn
@@ -23,5 +24,6 @@ __all__ = [
     "fused_vit_block",
     "grouped_ffn",
     "mha_reference",
+    "policy",
     "small_mha",
 ]

@@ -1,0 +1,665 @@
+"""Closed-loop autopilot: alert firings drive supervisor actions.
+
+The repo senses (heartbeats, straggler attribution, the ``--alert`` rule
+engine, the recompilation sentinel, HBM/RSS gauges) and acts
+(FleetSupervisor shrink/drain/expand, corrupt-shard quarantine, verified
+rollback) — but until this module a human was wired between the two: a
+persistent straggler only left the fleet when an operator wrote
+``host-i.down`` by hand.  :class:`PolicyEngine` closes the loop: it
+subscribes to the event stream (the supervisor's ``FleetWatcher`` tap, or
+an in-process ``bus.subscribe`` tap for unsupervised runs) and binds
+``alert`` firings to concrete actions.
+
+Spec grammar (one ``--policy`` flag per rule, repeatable)::
+
+    ALERT -> ACTION[:cooldown=S]
+
+    step/dispatch_s:p95>30:for=2 -> drain_host:cooldown=120
+    compile/recompiles_after_warmup:n>0 -> rewarm_serve
+    train/loss:p95>50 -> rollback:cooldown=300
+    sum(goodput/productive_frac):value<0.5 -> abort_with_evidence
+
+``ALERT`` is matched against the firing alert's spec (exact) or its
+metric name (so one policy rule can cover several thresholds on the same
+metric).  Actions:
+
+==================  ====================================================
+``drain_host``      write the same ``<ckpt>/fleet/host-i.down`` marker an
+                    operator writes today (the fleet path is IDENTICAL:
+                    the FleetSupervisor consumes the marker, drains the
+                    attempt, and re-renders the world without the host).
+                    The host is resolved from the alert's source process
+                    through ``fleet/status.json``'s rank→host map.
+``rewarm_serve``    re-run ``ServeEngine.warmup()`` on the affected
+                    bucket subset after a post-warmup recompile storm
+                    (in-process serving action; the serve session binds
+                    it).
+``rollback``        the existing watchdog rollback path (verified
+                    restore + replay).  Supervisor-side this defers
+                    through the request channel below; the trainer
+                    consumes it at the next epoch boundary.
+``abort_with_evidence``
+                    orderly abort: the blackbox ring plus the alert and
+                    policy timelines are attached to ``crash_dump.json``,
+                    and a supervising restart loop stops instead of
+                    relaunching a regressed run.
+==================  ====================================================
+
+Every decision — suppressed or acted — emits one registered ``policy``
+event (rule, triggering alert, action, cooldown/budget state, dry-run
+flag), so the loop is observable and replayable through the same bus as
+everything else (veScale's consistent-semantics argument, PAPERS.md).
+
+Safety rails (PR 7 caught the supervisor's own stall events reviving the
+host they called out — the inverse is pinned here: an automated actor
+must not be able to flap):
+
+- ``--policy-mode`` defaults to **dry-run**: decisions are made, logged,
+  cooldown/budget advance exactly as they would, but no executor runs —
+  the provable "what would it have done" rehearsal before ``act``;
+- per-rule **cooldowns** (default 60s): a firing→resolved→firing flap of
+  one alert cannot re-drive its action until the cooldown passes;
+- a global **actions-per-attempt budget** (``--policy-max-actions``): a
+  storm of distinct alerts cannot drain the whole fleet in one attempt.
+
+Deferred actions (``rollback`` / ``abort_with_evidence`` decided
+supervisor-side but applied in-process) travel through a request file
+under ``<ckpt>/fleet/`` — the same marker-file idiom as host
+re-admission — and the applying process emits the matching ``completed``
+/ ``failed`` event, so ``run_report --policy`` can flag an action that
+was requested but never landed (the process died first) with a nonzero
+exit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+POLICY_KIND = "policy"
+
+ACTIONS = ("drain_host", "rewarm_serve", "rollback", "abort_with_evidence")
+MODES = ("off", "dry-run", "act")
+DEFAULT_COOLDOWN_S = 60.0
+MAX_ACTIONS_DEFAULT = 4
+# the action budget re-grants on this clock in sessions that have no
+# attempt boundaries (unsupervised training, serving): a long-lived serve
+# session must rate-limit re-warms, not lose them forever after the
+# fourth storm
+BUDGET_WINDOW_S = 900.0
+
+# actions a supervisor-side decision defers to the training process via
+# the request channel (one shared file per action; the trainer polls at
+# epoch boundaries and process 0's read is broadcast under multi-host)
+REQUEST_ACTIONS = ("rollback", "abort_with_evidence")
+REQUEST_DIRNAME = "fleet"  # shared with the host marker files
+
+# decision end-states: every 'requested' id must reach one of these or
+# the run_report --policy / chaos pending gate flags it.  'coalesced' is
+# terminal-but-not-performed: the decision folded into an already-queued
+# request whose OWN id carries the real outcome — counting it as
+# 'completed' would score an action that never ran
+TERMINAL_STATES = ("completed", "failed", "coalesced")
+
+
+class PolicySpecError(ValueError):
+    """Malformed ``--policy`` spec."""
+
+
+class PolicyActionError(RuntimeError):
+    """An executor could not perform its action (reported as a ``failed``
+    policy event, never raised through the watching loop)."""
+
+
+class PolicyAbort(RuntimeError):
+    """Raised by the trainer applying ``abort_with_evidence`` — after the
+    evidence (blackbox ring + alert/policy timelines) has been dumped."""
+
+
+class PolicyRule:
+    """One compiled ``--policy`` spec: trigger → action."""
+
+    def __init__(
+        self, trigger: str, action: str,
+        cooldown_s: float = DEFAULT_COOLDOWN_S, spec: str | None = None,
+    ) -> None:
+        self.trigger = trigger
+        self.action = action
+        self.cooldown_s = float(cooldown_s)
+        self.spec = spec or f"{trigger} -> {action}:cooldown={cooldown_s:g}"
+
+    @classmethod
+    def parse(cls, spec: str) -> "PolicyRule":
+        # split on the LAST '->': alert specs ('p95>30:for=2') never
+        # contain the two-char arrow, but being positional about it keeps
+        # a future metric name containing '-' safe
+        head, sep, tail = spec.strip().rpartition("->")
+        if not sep or not head.strip() or not tail.strip():
+            raise PolicySpecError(
+                f"malformed --policy spec {spec!r}; expected "
+                "'ALERT -> ACTION[:cooldown=S]', e.g. "
+                "'step/dispatch_s:p95>30:for=2 -> drain_host:cooldown=120'"
+            )
+        trigger = head.strip()
+        action_part = tail.strip()
+        action, _, argstr = action_part.partition(":")
+        action = action.strip()
+        if action not in ACTIONS:
+            raise PolicySpecError(
+                f"--policy {spec!r}: unknown action {action!r} "
+                f"(choose from {', '.join(ACTIONS)})"
+            )
+        cooldown = DEFAULT_COOLDOWN_S
+        for pair in argstr.split(":"):
+            if not pair.strip():
+                continue
+            key, _, val = pair.partition("=")
+            key, val = key.strip(), val.strip()
+            if key != "cooldown":
+                raise PolicySpecError(
+                    f"--policy {spec!r}: unknown action arg {key!r} "
+                    "(known: cooldown)"
+                )
+            try:
+                cooldown = float(val)
+            except ValueError:
+                raise PolicySpecError(
+                    f"--policy {spec!r}: cooldown {val!r} is not a number"
+                ) from None
+            if cooldown < 0:
+                raise PolicySpecError(
+                    f"--policy {spec!r}: cooldown must be >= 0, got {cooldown}"
+                )
+        return cls(trigger, action, cooldown_s=cooldown, spec=spec.strip())
+
+    def matches(self, alert_payload: dict) -> bool:
+        """Does a firing alert trigger this rule?  Exact match on the
+        alert's spec, or on its metric name (one policy rule covering
+        every threshold written against that metric)."""
+        return self.trigger in (
+            alert_payload.get("spec"), alert_payload.get("metric"),
+        )
+
+
+def parse_policy_specs(specs) -> list[PolicyRule]:
+    """Compile ``--policy`` strings (raises ``PolicySpecError`` on the
+    first malformed one — a bad rule dies at the CLI, not at the first
+    alert of a run that already burned its startup)."""
+    return [PolicyRule.parse(s) for s in (specs or [])]
+
+
+def engine_from_hparams(hparams, *, bus, log=None) -> "PolicyEngine | None":
+    """The one construction path every session shares (supervisor,
+    trainer, serve): compile the ``--policy`` flags into an engine, or
+    None when there are no rules / the mode is ``off``.  Executors are
+    bound by the caller — that is the part that legitimately differs per
+    process."""
+    specs = getattr(hparams, "policy", None)
+    mode = getattr(hparams, "policy_mode", "dry-run")
+    if not specs or mode == "off":
+        return None
+    return PolicyEngine(
+        parse_policy_specs(specs),
+        bus=bus,
+        mode=mode,
+        max_actions=getattr(hparams, "policy_max_actions", MAX_ACTIONS_DEFAULT),
+        log=log,
+    )
+
+
+def validate_policy_rules(rules, alert_rules) -> None:
+    """Every policy trigger must name an existing ``--alert`` rule (its
+    spec or its metric) — a rule that can never fire is a typo, and the
+    place to learn that is the CLI, not a post-mortem."""
+    known: set[str] = set()
+    for r in alert_rules or ():
+        known.add(r.spec)
+        known.add(r.metric)
+    for rule in rules:
+        if rule.trigger not in known:
+            raise PolicySpecError(
+                f"--policy {rule.spec!r}: trigger {rule.trigger!r} matches "
+                f"no --alert rule (alert specs/metrics: "
+                f"{sorted(known) or 'none — pass --alert rules'})"
+            )
+
+
+class _RuleState:
+    __slots__ = ("last_armed",)
+
+    def __init__(self) -> None:
+        self.last_armed = -float("inf")  # clock of the last decision that
+        # armed the cooldown (acted, or would-have in dry-run)
+
+
+class PolicyEngine:
+    """Bind alert firings to actions, observably and rate-limited.
+
+    Feed it the event stream (``observe_event``) — the supervisor's
+    ``FleetWatcher`` does per poll, an unsupervised run's bus tap per
+    emit.  Only ``alert`` events with ``state == "firing"`` trigger
+    rules; ``attempt_start`` events reset the per-attempt action budget.
+    Executors are bound per action name (``bind``/``bind_actions``); an
+    executor may return a result dict folded into the ``completed``
+    event, return ``{"deferred": True}`` when another process will emit
+    the completion, or raise (→ a ``failed`` event).  Everything else —
+    mode, cooldown, budget — is decided here, identically in dry-run and
+    act mode, so the dry-run log is a faithful preview.
+    """
+
+    def __init__(
+        self, rules, *, bus=None, mode: str = "dry-run",
+        max_actions: int = MAX_ACTIONS_DEFAULT,
+        clock=time.monotonic, log=None,
+    ) -> None:
+        if mode not in MODES:
+            raise PolicySpecError(
+                f"--policy-mode {mode!r}: choose from {', '.join(MODES)}"
+            )
+        self.rules = list(rules)
+        self.bus = bus
+        self.mode = mode
+        self.max_actions = max(1, int(max_actions))
+        self._clock = clock
+        self._log = log or (lambda msg: None)
+        self._actions: dict = {}
+        self._lock = threading.Lock()
+        self._state = [_RuleState() for _ in self.rules]
+        self._attempt = 0
+        self._attempt_spent = 0
+        self._budget_window_start = self._clock()
+        # alert events older than this engine are HISTORY, not findings:
+        # the supervisor's watcher tails event files from byte 0, so a
+        # restart over an existing ckpt root replays every old firing —
+        # acting on one would drain a now-healthy host or abort a fresh
+        # run over a previous session's tripwire
+        self._ignore_before = time.time()
+        # decision ids carry a per-engine token: two supervisor sessions
+        # over one ckpt root must not mint colliding ids, or the pending
+        # gate could pair a new session's 'requested' with an old
+        # session's 'completed' and miss a genuinely lost action
+        self._token = os.urandom(3).hex()
+        self._seq = 0
+        self.decisions: list[dict] = []  # every emitted policy payload
+        self._pending: dict[str, dict] = {}  # id -> requested, no outcome yet
+
+    # ---------------------------------------------------------- executors
+
+    def bind(self, action: str, fn) -> "PolicyEngine":
+        if action not in ACTIONS:
+            raise PolicySpecError(f"unknown policy action {action!r}")
+        self._actions[action] = fn
+        return self
+
+    def bind_actions(self, mapping: dict) -> "PolicyEngine":
+        for action, fn in mapping.items():
+            self.bind(action, fn)
+        return self
+
+    # ------------------------------------------------------------- events
+
+    def reset_attempt(self, attempt: int) -> None:
+        """A new supervised attempt re-grants the action budget (the
+        cooldown clocks deliberately survive: a drain at the end of
+        attempt N must still hold its rule through attempt N+1's start).
+        Idempotent per attempt index — the explicit supervisor call and
+        the tailed ``attempt_start`` event may both land."""
+        with self._lock:
+            if int(attempt) > self._attempt:
+                self._attempt = int(attempt)
+                self._attempt_spent = 0
+                self._budget_window_start = self._clock()
+
+    def observe_event(self, ev: dict) -> None:
+        if self.mode == "off" or not isinstance(ev, dict):
+            return
+        kind = ev.get("kind")
+        if kind == "attempt_start":
+            self.reset_attempt(int((ev.get("payload") or {}).get("attempt", 0)))
+            return
+        if kind == POLICY_KIND:
+            # a deferred action's outcome arrives as a policy event from
+            # the APPLYING process (the watcher tails it back): fold it
+            # into the pending ledger so summary() agrees with the stream
+            p = ev.get("payload") or {}
+            if p.get("state") in TERMINAL_STATES and p.get("id") is not None:
+                with self._lock:
+                    self._pending.pop(p["id"], None)
+            return
+        if kind != "alert":
+            return
+        t_wall = ev.get("t_wall")
+        if isinstance(t_wall, (int, float)) and t_wall < self._ignore_before:
+            return  # replayed history (see _ignore_before)
+        payload = ev.get("payload") or {}
+        if payload.get("state") != "firing":
+            return
+        for idx, rule in enumerate(self.rules):
+            if rule.matches(payload):
+                self._decide(idx, payload)
+
+    # ----------------------------------------------------------- decision
+
+    def _emit(self, payload: dict) -> dict:
+        self.decisions.append(payload)
+        if payload["state"] == "requested":
+            self._pending[payload["id"]] = payload
+        elif payload["state"] in TERMINAL_STATES:
+            self._pending.pop(payload.get("id"), None)
+        if self.bus is not None:
+            self.bus.emit(POLICY_KIND, **payload)
+        return payload
+
+    def _decide(self, idx: int, alert_payload: dict) -> None:
+        rule = self.rules[idx]
+        now = self._clock()
+        # resolved BEFORE the cooldown/budget section: an action with no
+        # executor in this process can do nothing, so it must not arm the
+        # rule's cooldown or spend the shared budget — four firings of an
+        # un-runnable rule would otherwise starve the runnable ones.
+        # Executors are bound identically in both modes, so dry-run
+        # classifies unbound the same way act would — the preview must
+        # show the suppressions act mode would actually apply
+        fn = self._actions.get(rule.action)
+        with self._lock:
+            self._seq += 1
+            decision = {
+                "rule": rule.spec,
+                "action": rule.action,
+                "trigger": alert_payload.get("spec"),
+                "alert_source": alert_payload.get("source"),
+                "alert_value": alert_payload.get("value"),
+                "mode": self.mode,
+                "dry_run": self.mode != "act",
+                "cooldown_s": rule.cooldown_s,
+                "id": f"{self._token}-a{self._attempt}-{self._seq}",
+                "attempt": self._attempt,
+            }
+            st = self._state[idx]
+            if now - self._budget_window_start >= BUDGET_WINDOW_S:
+                # sessions with no attempt boundaries (serving,
+                # unsupervised runs) re-grant the budget on a clock —
+                # the cap rate-limits storms, it must not permanently
+                # disable the autopilot after max_actions decisions
+                self._budget_window_start = now
+                self._attempt_spent = 0
+            remaining = rule.cooldown_s - (now - st.last_armed)
+            if fn is None:
+                decision["state"] = "unbound"
+                suppressed = True
+            elif remaining > 0:
+                decision.update(
+                    state="cooldown", cooldown_remaining_s=round(remaining, 3)
+                )
+                suppressed = True
+            elif self._attempt_spent >= self.max_actions:
+                decision.update(
+                    state="budget", budget=self.max_actions,
+                    budget_spent=self._attempt_spent,
+                )
+                suppressed = True
+            else:
+                # the decision stands: arm the cooldown and spend budget in
+                # BOTH modes, so dry-run previews exactly what act would do
+                st.last_armed = now
+                self._attempt_spent += 1
+                decision["budget_spent"] = self._attempt_spent
+                suppressed = False
+        if suppressed:
+            if decision["state"] == "unbound":
+                self._log(
+                    f"policy: no executor bound for {rule.action!r} in "
+                    f"this process; rule {rule.spec} not applied"
+                )
+            self._emit(decision)
+            return
+        if self.mode != "act":
+            decision["state"] = "dry_run"
+            self._log(
+                f"policy (dry-run): {rule.spec} would run {rule.action} "
+                f"for alert {decision['trigger']!r} "
+                f"(source {decision['alert_source']})"
+            )
+            self._emit(decision)
+            return
+        self._emit(dict(decision, state="requested"))
+        self._log(
+            f"policy: {rule.spec} -> running {rule.action} for alert "
+            f"{decision['trigger']!r} (source {decision['alert_source']})"
+        )
+        try:
+            result = fn(dict(decision))
+        except Exception as e:  # acting must never kill the watching loop
+            self._emit(dict(decision, state="failed", error=str(e)))
+            return
+        result = result or {}
+        if result.get("deferred"):
+            # the applying process (trainer) emits completed/failed with
+            # this decision's id once the request lands
+            return
+        if result.get("coalesced"):
+            # folded into an already-queued request: terminal for the
+            # pending gate, but NOT 'completed' — the queued request's
+            # own id will carry whether the action actually happened
+            self._emit(dict(decision, state="coalesced", **result))
+            return
+        self._emit(dict(decision, state="completed", **result))
+
+    # ------------------------------------------------------------ reports
+
+    def pending(self) -> list[dict]:
+        """Requested actions with no completion seen BY THIS ENGINE (a
+        deferred request's completion is emitted by another process;
+        ``run_report --policy`` joins the merged stream instead)."""
+        with self._lock:
+            return list(self._pending.values())
+
+    def summary(self) -> dict:
+        counts: dict[str, int] = {}
+        for d in self.decisions:
+            counts[d["state"]] = counts.get(d["state"], 0) + 1
+        return {
+            "mode": self.mode,
+            "rules": [r.spec for r in self.rules],
+            "decisions": len(self.decisions),
+            "by_state": counts,
+            "pending": [p["id"] for p in self.pending()],
+        }
+
+
+# --------------------------------------------- deferred-request channel
+
+
+def request_filename(action: str) -> str:
+    return f"policy-{action}.req"
+
+
+def write_action_request(root, action: str, payload: dict) -> Path | None:
+    """Persist a deferred action request under ``<root>/fleet/`` (the
+    marker-file idiom).  Rename-atomic: the polling trainer never reads a
+    torn request.
+
+    One file per action, and an UNCONSUMED file wins: overwriting a
+    pending request would orphan its id — the trainer would never see it,
+    so its ``requested`` event would read as pending forever.  Returns
+    None when an earlier request is still queued (the caller reports the
+    new decision as coalesced into it; one boundary application satisfies
+    both)."""
+    if action not in REQUEST_ACTIONS:
+        raise PolicyActionError(
+            f"{action!r} is not a deferrable action ({REQUEST_ACTIONS})"
+        )
+    d = Path(root) / REQUEST_DIRNAME
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / request_filename(action)
+    if path.exists():
+        return None
+    tmp = path.with_suffix(".req.tmp")
+    tmp.write_text(json.dumps(dict(payload, action=action)))
+    tmp.replace(path)
+    return path
+
+
+class PolicyRequestPoller:
+    """The trainer side of the request channel: consume any pending
+    ``policy-*.req`` files under ``<root>/fleet/``.  Cost when idle: one
+    ``stat`` per deferrable action per poll (the trainer polls at epoch
+    boundaries).  Only process 0 polls; the decision is broadcast so the
+    whole fleet acts symmetrically (the rollback path runs collectives).
+    """
+
+    def __init__(self, root) -> None:
+        self.dir = Path(root) / REQUEST_DIRNAME
+
+    def poll(self) -> list[dict]:
+        out: list[dict] = []
+        for action in REQUEST_ACTIONS:
+            path = self.dir / request_filename(action)
+            try:
+                text = path.read_text()
+            except OSError:
+                continue
+            path.unlink(missing_ok=True)
+            try:
+                req = json.loads(text)
+            except ValueError:
+                req = {}
+            if not isinstance(req, dict):
+                req = {}
+            req.setdefault("action", action)
+            out.append(req)
+        return out
+
+
+def emit_completion(
+    bus, request: dict, ok: bool = True, error: str | None = None,
+    state: str | None = None, **result,
+) -> dict:
+    """The applying process's half of a deferred action: one ``policy``
+    event carrying the request's id with the outcome, so the merged
+    stream pairs every ``requested`` with a terminal state.  ``state``
+    overrides the ok/error mapping — the trainer marks requests
+    superseded by a same-boundary abort ``coalesced``, not
+    ``completed``."""
+    payload = {
+        "rule": request.get("rule"),
+        "action": request.get("action"),
+        "id": request.get("id"),
+        "state": state or ("completed" if ok else "failed"),
+        **result,
+    }
+    if error is not None:
+        payload["error"] = str(error)
+    return bus.emit(POLICY_KIND, **payload)
+
+
+# ------------------------------------------------- supervisor executors
+
+
+def supervisor_actions(
+    ckpt_root, *, fleet_hosts: int = 0, request_stop=None,
+) -> dict:
+    """The supervisor-side executor set.
+
+    ``drain_host`` writes the SAME ``host-i.down`` marker an operator
+    writes today — the fleet consumption path is byte-identical, so
+    everything proven about manual drains (mid-attempt drain, world
+    re-render, budget semantics) holds for automated ones.  ``rollback``
+    and ``abort_with_evidence`` defer through the request channel (the
+    state they act on lives in the training process); the abort
+    additionally asks the restart loop to stop, so a regressed run is
+    not relaunched over its own evidence.  ``rewarm_serve`` is absent on
+    purpose: serving runs in-process and binds its own — leaving it
+    genuinely UNBOUND here means a supervisor-side rewarm rule is
+    reported (state ``unbound``) without arming its cooldown or burning
+    the shared budget on decisions that could only fail.
+    """
+    root = Path(ckpt_root)
+
+    def _host_of(decision: dict) -> int:
+        src = decision.get("alert_source")
+        if not (isinstance(src, str) and src.startswith("p")):
+            raise PolicyActionError(
+                f"drain_host needs a per-process alert source, got "
+                f"{src!r} (fleet-aggregate rules name no host)"
+            )
+        rank = int(src[1:])
+        # the alert source is a RANK; after a shrink ranks and hosts
+        # diverge — map through the live launch set when it is readable
+        try:
+            status = json.loads(
+                (root / REQUEST_DIRNAME / "status.json").read_text()
+            )
+            return int(status["hosts"][rank])
+        except (OSError, ValueError, KeyError, IndexError, TypeError):
+            return rank
+
+    def drain_host(decision: dict) -> dict:
+        if fleet_hosts <= 1:
+            raise PolicyActionError(
+                "drain_host needs an elastic fleet (--fleet-hosts > 1)"
+            )
+        host = _host_of(decision)
+        d = root / REQUEST_DIRNAME
+        d.mkdir(parents=True, exist_ok=True)
+        marker = d / f"host-{host}.down"
+        marker.write_text(
+            json.dumps({"by": "policy", "rule": decision.get("rule"),
+                        "id": decision.get("id")})
+        )
+        return {"host": host, "marker": marker.name}
+
+    def rollback(decision: dict) -> dict:
+        if write_action_request(root, "rollback", decision) is None:
+            # an unconsumed request is already queued: one boundary
+            # application satisfies both — this decision completes NOW
+            # instead of orphaning an id nobody will ever apply
+            return {"coalesced": True}
+        return {"deferred": True}
+
+    def abort_with_evidence(decision: dict) -> dict:
+        queued = write_action_request(root, "abort_with_evidence", decision)
+        if request_stop is not None:
+            request_stop(
+                f"policy abort_with_evidence ({decision.get('rule')})"
+            )
+        if queued is None:
+            return {"coalesced": True}
+        return {"deferred": True}
+
+    return {
+        "drain_host": drain_host,
+        "rollback": rollback,
+        "abort_with_evidence": abort_with_evidence,
+    }
+
+
+# ------------------------------------------------- offline (run_report)
+
+
+def policy_timeline(events) -> list[dict]:
+    """The ``policy`` events of a merged stream, in order."""
+    return [
+        ev for ev in events
+        if isinstance(ev, dict) and ev.get("kind") == POLICY_KIND
+    ]
+
+
+def pending_actions(events) -> list[dict]:
+    """``requested`` policy events with no terminal event
+    (``completed``/``failed``/``coalesced``) sharing their id anywhere in
+    the merged stream — an action that was decided but never landed (the
+    applying process died first)."""
+    requested: dict[object, dict] = {}
+    done: set = set()
+    for ev in policy_timeline(events):
+        p = ev.get("payload") or {}
+        state, pid = p.get("state"), p.get("id")
+        if state == "requested" and pid is not None:
+            requested[pid] = p
+        elif state in TERMINAL_STATES and pid is not None:
+            done.add(pid)
+    return [p for pid, p in requested.items() if pid not in done]

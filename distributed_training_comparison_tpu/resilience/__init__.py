@@ -48,7 +48,14 @@ from .elastic import (
     topology,
     validate_reshard,
 )
-from .faults import FaultEvent, FaultPlan, FaultSpecError
+from .faults import (
+    CHAOS_KIND,
+    CHAOS_SCENARIOS,
+    FaultEvent,
+    FaultPlan,
+    FaultSpecError,
+    check_chaos_expectations,
+)
 from .fleet import FleetPlanError, FleetSupervisor, widest_legal_world
 from .goodput import GoodputMeter, aggregate_goodput, load_goodput_records
 from .preempt import EXIT_PREEMPTED, Preempted, PreemptionHandler
@@ -72,6 +79,9 @@ __all__ = [
     "FleetPlanError",
     "FleetSupervisor",
     "widest_legal_world",
+    "CHAOS_KIND",
+    "CHAOS_SCENARIOS",
+    "check_chaos_expectations",
     "FaultEvent",
     "FaultPlan",
     "FaultSpecError",

@@ -289,3 +289,70 @@ def rotate_previous(path: str | Path) -> Path | None:
     else:  # legacy current had no manifest: drop any stale prev manifest
         prev_manifest.unlink(missing_ok=True)
     return prev
+
+
+# --------------------------------------------- quarantine persistence
+
+QUARANTINE_SIDECAR_PREFIX = "quarantine-p"
+
+
+def quarantine_sidecar_path(directory: str | Path, process_index: int) -> Path:
+    """Per-rank quarantine sidecar next to the checkpoints: the resume
+    manifest is written by process 0 only, so under multi-host it carries
+    only rank 0's corrupt-shard set — every rank persists its OWN set
+    here, and a relaunch unions them all back."""
+    return Path(directory) / f"{QUARANTINE_SIDECAR_PREFIX}{int(process_index)}.json"
+
+
+def write_quarantine_sidecar(
+    directory: str | Path, process_index: int, example_ids,
+) -> Path | None:
+    """Persist one rank's quarantined example ids (rename-atomic; no
+    fsync — the set is advisory next to the durable checkpoint).  Empty
+    sets write nothing; failures return None (quarantine persistence must
+    never kill the rollback that produced it)."""
+    ids = sorted(int(i) for i in example_ids or ())
+    if not ids:
+        return None
+    path = quarantine_sidecar_path(directory, process_index)
+    try:
+        atomic_write_bytes(path, json.dumps(ids).encode(), durable=False)
+    except OSError:
+        return None
+    return path
+
+
+def _int_ids(seq) -> set[int]:
+    """Coerce advisory id lists leniently: a non-integer entry (schema
+    drift, a hand edit) is dropped, never raised — the quarantine files
+    must not be able to block a resume."""
+    out: set[int] = set()
+    for i in seq or ():
+        try:
+            out.add(int(i))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def union_quarantine(directory: str | Path, base=None) -> list[int]:
+    """The fleet-wide quarantine set at resume time: the manifest's list
+    (rank 0's, ``base``) unioned with every ``quarantine-p*.json`` sidecar
+    in the checkpoint's directory.  Unreadable sidecars — and non-integer
+    entries inside readable ones — are skipped: a torn or drifted
+    advisory file must not block a resume."""
+    merged = _int_ids(base)
+    try:
+        sidecars = sorted(
+            Path(directory).glob(f"{QUARANTINE_SIDECAR_PREFIX}*.json")
+        )
+    except OSError:
+        sidecars = []
+    for path in sidecars:
+        try:
+            ids = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(ids, list):
+            merged.update(_int_ids(ids))
+    return sorted(merged)

@@ -80,6 +80,47 @@ class FaultSpecError(ValueError):
     """Malformed ``--fault-plan`` spec."""
 
 
+def _reject_conflicts(events: list) -> None:
+    """Refuse duplicate / overlapping specs of the same kind+window.
+
+    Composed chaos scenarios stack many kinds in one plan; what they must
+    NOT stack is two events of the same kind aimed at the same window —
+    today those silently double-fire, and the second firing lands on the
+    rollback REPLAY that is contractually clean (``step_fault`` consumes
+    one event per epoch pass), corrupting the chaos scoreboard's
+    fault→alert→action attribution.  Rules (``prob=`` draws are exempt —
+    their windows are not knowable at parse time):
+
+    - step faults (``nan_grad``/``bad_batch``/``loss_spike``) and
+      ``desync``: two events of the same kind due at the same epoch
+      conflict, whatever their step offsets — only the first fires on the
+      first pass, so the second can ONLY fire on a replay;
+    - ``preempt``/``ckpt_fail``/``torn_write``/``stall``: same kind,
+      same epoch, same step offset is a duplicate (distinct mid-epoch
+      preempt steps in one epoch are a legitimate composition — each
+      relaunch resumes past the previous one).
+    """
+    seen: dict[tuple, "FaultEvent"] = {}
+    for e in events:
+        if e.epoch is None:
+            continue
+        if e.kind in STEP_KINDS or e.kind == "desync":
+            key = (e.kind, e.epoch)
+        else:
+            key = (e.kind, e.epoch, e.step)
+        other = seen.get(key)
+        if other is not None:
+            raise FaultSpecError(
+                f"fault plan: {other.spec!r} and {e.spec!r} target the "
+                f"same kind+window (kind {e.kind!r}, epoch {e.epoch}"
+                + ("" if len(key) == 2 else f", step {e.step}")
+                + ") — they would silently double-fire (the second on the "
+                "rollback replay that must run clean); merge them into "
+                "one event or move one to a different window"
+            )
+        seen[key] = e
+
+
 @dataclass
 class FaultEvent:
     kind: str
@@ -91,6 +132,8 @@ class FaultEvent:
     steps: int | None = None   # step-fault width (defaults per kind)
     scale: float | None = None # step-fault multiplier (defaults per kind)
     consumed: bool = field(default=False, compare=False)
+    spec: str = field(default="", compare=False)  # original item text,
+                               # for conflict errors that name both specs
 
     def due(self, epoch: int, seed: int) -> bool:
         if self.epoch is not None:
@@ -161,7 +204,8 @@ class FaultPlan:
                 raise FaultSpecError(
                     f"fault {item!r} needs an epoch=K or prob=P trigger"
                 )
-            events.append(FaultEvent(kind=kind, **kwargs))
+            events.append(FaultEvent(kind=kind, spec=item, **kwargs))
+        _reject_conflicts(events)
         return cls(events=events, seed=seed)
 
     def _due(self, kind: str, epoch: int) -> list[FaultEvent]:
@@ -275,3 +319,229 @@ def tear_file(path: str | Path) -> None:
     path = Path(path)
     data = path.read_bytes()
     path.write_bytes(data[: max(1, len(data) // 2)])
+
+
+# ------------------------------------------------------- chaos matrix
+
+CHAOS_KIND = "chaos"
+
+# The emulated-rank injection knob (tests/fleet_pool_worker.py): a rank>0
+# host reading this env var reports a persistently slowed step/dispatch_s
+# sketch of that many seconds — the persistent straggler a policy rule
+# must drain.  Emission waits for rank 0's first verified checkpoint, so
+# the drain always lands on a resumable run.
+EMU_SLOW_DISPATCH_ENV = "DTC_EMU_SLOW_DISPATCH_S"
+
+# The shared sensing/acting vocabulary of the gauntlet: one alert + one
+# policy rule per failure mode, reused verbatim across scenarios so the
+# scoreboard's columns compare like with like.
+_STRAGGLER_ALERT = "step/dispatch_s:p95>30:for=2"
+_STRAGGLER_POLICY = f"{_STRAGGLER_ALERT} -> drain_host:cooldown=120"
+_SPIKE_ALERT = "train/loss:p95>50:for=1"
+_SPIKE_POLICY = f"{_SPIKE_ALERT} -> rollback:cooldown=300"
+_SKIP_ALERT = "train/skipped_steps:n>0:for=1"
+_ABORT_ALERT = "train/loss:p95>-1:for=1"  # always-breaching tripwire
+_ABORT_POLICY = f"{_ABORT_ALERT} -> abort_with_evidence:cooldown=600"
+
+# Named scenarios composing preempt x straggler-stall x corrupt-shard
+# (nan_grad) x host-flap, each run end-to-end under the fleet supervisor
+# with the policy engine active (bench.py --chaos -> CHAOS.json).  Every
+# scenario recovers via policy/supervisor actions alone: the only marker
+# file a driver ever writes is ``host-1.up`` — the SCHEDULER's
+# re-admission interface (ROADMAP residue: a GCE/k8s probe would write
+# it), never an operator's ``host-i.down``.
+#
+# Field contract (consumed by ``bench.py --chaos`` and linted by tests):
+#   fault_plan   --fault-plan spec for the training child (or None)
+#   alerts       --alert specs handed to the supervisor
+#   policies     --policy specs binding those alerts to actions
+#   policy_mode  off | dry-run | act
+#   driver       None | "kill_host1" | "kill_and_readmit_host1" — the
+#                external-environment script (spot reclaim / scheduler)
+#   env          extra child environment (emulated-rank injection knobs)
+#   extra_args   extra child CLI flags
+#   expect       scoreboard expectations, checked by
+#                ``check_chaos_expectations``:  key / key__min / key__max
+#   require_kinds  event kinds the scenario's stream must carry
+CHAOS_SCENARIOS: dict[str, dict] = {
+    "straggler_drain": {
+        "desc": "persistent straggler on host 1 -> dispatch alert -> "
+                "policy drain_host -> world shrinks -> run completes",
+        "fault_plan": None,
+        "alerts": (_STRAGGLER_ALERT,),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {EMU_SLOW_DISPATCH_ENV: "60"},
+        "extra_args": (),
+        "expect": {
+            "final_rc": 0, "policy_completed__min": 1,
+            "resizes__min": 1, "alerts_fired__min": 1,
+            "policy_dry_run": 0,
+        },
+        "require_kinds": ("policy", "resize"),
+    },
+    "straggler_dryrun": {
+        "desc": "same straggler, --policy-mode dry-run: the decision is "
+                "logged, NO drain happens, the world never shrinks",
+        "fault_plan": None,
+        "alerts": (_STRAGGLER_ALERT,),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "dry-run",
+        "driver": None,
+        "env": {EMU_SLOW_DISPATCH_ENV: "60"},
+        "extra_args": (),
+        "expect": {
+            "final_rc": 0, "policy_dry_run__min": 1,
+            "policy_completed": 0, "policy_requested": 0,
+            "resizes": 0, "restarts": 0,
+        },
+        "require_kinds": ("policy",),
+    },
+    "preempt_resume": {
+        "desc": "injected preemption mid-run -> supervisor relaunch "
+                "resumes from the verified checkpoint",
+        "fault_plan": "preempt@epoch=2",
+        "alerts": (_STRAGGLER_ALERT,),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {},
+        "extra_args": (),
+        "expect": {
+            "final_rc": 0, "preemptions__min": 1, "restarts__min": 1,
+            "policy_completed": 0,
+        },
+        "require_kinds": ("preempt",),
+    },
+    "nan_rollback": {
+        "desc": "corrupt shard (nan_grad) -> compiled guard skips, "
+                "watchdog rolls back, skipped-steps alert fires",
+        "fault_plan": "nan_grad@epoch=1",
+        "alerts": (_SKIP_ALERT, _STRAGGLER_ALERT),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {},
+        "extra_args": (),
+        "expect": {
+            "final_rc": 0, "rollbacks__min": 1, "alerts_fired__min": 1,
+        },
+        "require_kinds": ("rollback", "alert"),
+    },
+    "policy_rollback": {
+        "desc": "sustained loss breach the (deliberately blinded) spike "
+                "detector ignores -> loss alert -> policy rollback "
+                "request -> trainer rolls back and replays clean",
+        # the stall after epoch 6 is the insurance window: the alert ->
+        # policy -> request chain (one watcher poll each way) must land
+        # before the short CI run's last epoch boundary
+        "fault_plan": "loss_spike@epoch=5:scale=64:steps=3;"
+                      "stall@epoch=6:secs=4",
+        "alerts": (_SPIKE_ALERT,),
+        "policies": (_SPIKE_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {},
+        # spike detection blinded so the POLICY path (not the watchdog)
+        # performs the recovery; sparse saves keep the spiked trajectory
+        # out of last.ckpt while the request is in flight
+        "extra_args": (
+            "--health-spike-mads", "1e9", "--save-last-every", "5",
+        ),
+        "expect": {
+            "final_rc": 0, "policy_completed__min": 1,
+            "rollbacks__min": 1, "alerts_fired__min": 1,
+        },
+        "require_kinds": ("policy", "rollback"),
+    },
+    "host_flap": {
+        "desc": "host 1 SIGKILLed (spot reclaim) -> shrink -> scheduler "
+                "re-admits it (host-1.up) -> deliberate re-expand",
+        "fault_plan": "stall@epoch=7:secs=6",  # insurance window so the
+        # re-admission lands mid-run even on a fast box
+        "alerts": (_STRAGGLER_ALERT,),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "act",
+        "driver": "kill_and_readmit_host1",
+        "env": {},
+        "extra_args": (),
+        "expect": {
+            "final_rc": 0, "resizes__min": 2, "policy_completed": 0,
+        },
+        "require_kinds": ("resize",),
+    },
+    "composed": {
+        "desc": "nan_grad + mid-run preempt + persistent straggler at "
+                "once: rollback, relaunch, and policy drain in one run",
+        "fault_plan": "nan_grad@epoch=1;preempt@epoch=3",
+        "alerts": (_SKIP_ALERT, _STRAGGLER_ALERT),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {EMU_SLOW_DISPATCH_ENV: "60"},
+        "extra_args": (),
+        "expect": {
+            "final_rc": 0, "rollbacks__min": 1, "restarts__min": 1,
+            "policy_completed__min": 1, "resizes__min": 1,
+        },
+        "require_kinds": ("policy", "resize", "rollback"),
+    },
+    "abort_evidence": {
+        "desc": "sustained regression tripwire -> policy "
+                "abort_with_evidence: orderly abort, evidence attached "
+                "to crash_dump.json, restart loop stops (no relaunch)",
+        "fault_plan": None,
+        "alerts": (_ABORT_ALERT,),
+        "policies": (_ABORT_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {},
+        "extra_args": (),
+        "expect": {
+            "final_rc_nonzero": True, "policy_completed__min": 1,
+            "restarts": 0, "crash_dump_evidence": True,
+        },
+        "require_kinds": ("policy", "abort"),
+    },
+}
+
+
+def check_chaos_expectations(expect: dict, observed: dict) -> list[str]:
+    """Compare a scenario's scoreboard row against its ``expect`` block;
+    returns the violations (empty = scenario green).  Keys: ``name`` for
+    exact equality, ``name__min`` / ``name__max`` for bounds, and
+    ``final_rc_nonzero`` / ``crash_dump_evidence`` as boolean checks."""
+    problems: list[str] = []
+    for key, want in expect.items():
+        if key == "final_rc_nonzero":
+            if bool(observed.get("final_rc", 0) != 0) is not bool(want):
+                problems.append(
+                    f"final_rc={observed.get('final_rc')} (wanted "
+                    f"{'nonzero' if want else 'zero'})"
+                )
+            continue
+        if key == "crash_dump_evidence":
+            if bool(observed.get("crash_dump_evidence")) is not bool(want):
+                problems.append(
+                    f"crash_dump_evidence={observed.get('crash_dump_evidence')}"
+                    f" (wanted {want})"
+                )
+            continue
+        if key.endswith("__min"):
+            name, cmp = key[: -len("__min")], ">="
+        elif key.endswith("__max"):
+            name, cmp = key[: -len("__max")], "<="
+        else:
+            name, cmp = key, "=="
+        got = observed.get(name)
+        if got is None:
+            problems.append(f"{name} missing from the scoreboard row")
+            continue
+        ok = (
+            got >= want if cmp == ">=" else
+            got <= want if cmp == "<=" else got == want
+        )
+        if not ok:
+            problems.append(f"{name}={got} (wanted {cmp} {want})")
+    return problems

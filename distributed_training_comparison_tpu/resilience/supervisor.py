@@ -86,6 +86,18 @@ class Supervisor:
         # restart loop itself shows up on the unified timeline.  Optional —
         # the Supervisor stays importable without the obs package wired.
         self._events = events or (lambda kind, **payload: None)
+        # orderly-stop request (the policy engine's abort_with_evidence):
+        # once set, the loop ends after the CURRENT attempt instead of
+        # relaunching — a run stopped over its own evidence must not be
+        # restarted on top of it
+        self._stop_reason: str | None = None
+
+    def request_stop(self, reason: str) -> None:
+        """Ask the restart loop to stop after the in-flight attempt ends
+        (thread-safe: a one-shot str assignment).  First reason wins."""
+        if self._stop_reason is None:
+            self._stop_reason = str(reason)
+            self._log(f"stop requested: {reason}")
 
     def _resolve(self, attempt: int) -> tuple[list[str], dict | None]:
         cmd = self._cmd(attempt) if callable(self._cmd) else self._cmd
@@ -161,6 +173,19 @@ class Supervisor:
                 }
             )
             if rc == 0:
+                break
+            if self._stop_reason is not None:
+                # requested mid-attempt (policy abort): the attempt's own
+                # nonzero rc stands, but no relaunch follows — the stop is
+                # the point
+                self._log(
+                    f"stopping after attempt {attempt} (rc={rc}): "
+                    f"{self._stop_reason}"
+                )
+                self._events(
+                    "give_up", attempt=attempt, returncode=rc,
+                    reason=self._stop_reason,
+                )
                 break
             progressed = False
             if self._progress is not None:
@@ -351,16 +376,6 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
         # per-process rules evaluate here too, as before
         fleet=True,
     )
-    watcher = (
-        obs.FleetWatcher(
-            hparams.ckpt_path, bus, tracker=tracker, engine=engine,
-            # steady-state cadence; the watcher tightens itself to ~100ms
-            # while any host is degraded (obs/heartbeat.py adaptive poll)
-            poll_s=getattr(hparams, "fleet_poll_secs", 1.0),
-        )
-        if obs_enabled
-        else None
-    )
     emitted_stragglers: set[tuple] = set()
     # attribution input, accumulated INCREMENTALLY: one persistent tailer
     # plus a metrics-only buffer, so attempt N's pass doesn't re-read and
@@ -387,6 +402,10 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
                     attempt=int(payload.get("attempt", 0)),
                 )
             engine.reset_fleet()
+            if policy_engine is not None:
+                # re-grant the per-attempt action budget (idempotent by
+                # attempt index — the tailed attempt_start lands too)
+                policy_engine.reset_attempt(int(payload.get("attempt", 0)))
         if kind == "attempt_end" and obs_enabled:
             # the black-box pull: decode every host's mmap flight ring
             # under the ckpt root (version dirs included) into ONE
@@ -413,7 +432,7 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
                 tracker.reset()
 
     fleet_hosts = int(getattr(hparams, "fleet_hosts", 0) or 0)
-    policy = dict(
+    restart_policy = dict(
         max_restarts=getattr(hparams, "max_restarts", 3),
         backoff_base=getattr(hparams, "restart_backoff", 1.0),
         progress=progress_probe,
@@ -426,10 +445,44 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
 
         sup = FleetSupervisor(
             cmd_for, env=env_for, ckpt_root=hparams.ckpt_path,
-            **fleet_env_knobs(hparams), **policy,
+            **fleet_env_knobs(hparams), **restart_policy,
         )
     else:
-        sup = Supervisor(cmd_for, env=env_for, **policy)
+        sup = Supervisor(cmd_for, env=env_for, **restart_policy)
+
+    # --- the closed-loop autopilot (ops/policy.py): --policy rules bind
+    # alert firings to supervisor actions.  The engine is fed by the fleet
+    # watcher's tail — ONE delivery path (the alert engine's own emits
+    # land in the supervisor's events.jsonl and come back through the
+    # tailer one poll later), so an alert can never double-drive an
+    # action.  drain_host writes the same host-i.down marker an operator
+    # writes; rollback/abort defer through the request channel to the
+    # training process; abort additionally stops the restart loop.
+    from ..ops import policy as policy_mod
+
+    policy_engine = policy_mod.engine_from_hparams(
+        hparams, bus=bus, log=sup._log
+    )
+    if policy_engine is not None:
+        policy_engine.bind_actions(
+            policy_mod.supervisor_actions(
+                hparams.ckpt_path,
+                fleet_hosts=fleet_hosts,
+                request_stop=sup.request_stop,
+            )
+        )
+
+    watcher = (
+        obs.FleetWatcher(
+            hparams.ckpt_path, bus, tracker=tracker, engine=engine,
+            policy=policy_engine,
+            # steady-state cadence; the watcher tightens itself to ~100ms
+            # while any host is degraded (obs/heartbeat.py adaptive poll)
+            poll_s=getattr(hparams, "fleet_poll_secs", 1.0),
+        )
+        if obs_enabled
+        else None
+    )
     t_start = time.time()
     if watcher is not None:
         watcher.start()
@@ -438,6 +491,24 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
     finally:
         if watcher is not None:
             watcher.stop()
+    if policy_engine is not None:
+        # sweep requests no attempt lived to apply (written after the
+        # final epoch-boundary poll, or the run ended first): give each
+        # id a terminal 'failed' outcome so a completed run's timeline
+        # never carries a forever-pending action.  The event is fed back
+        # through the engine so its pending ledger (GOODPUT's
+        # supervisor.policy) agrees with the stream run_report reads
+        for req in policy_mod.PolicyRequestPoller(hparams.ckpt_path).poll():
+            if req.get("id") is not None:
+                policy_engine.observe_event(
+                    policy_mod.emit_completion(
+                        bus, req, ok=False,
+                        error="run ended before the request was applied",
+                    )
+                )
+        # the autopilot's ledger rides the supervisor summary into
+        # GOODPUT.json: decisions by state, rules, anything still pending
+        summary["policy"] = policy_engine.summary()
 
     # aggregate the per-attempt goodput records the children appended —
     # across ALL version dirs (an attempt that died pre-first-save leaves

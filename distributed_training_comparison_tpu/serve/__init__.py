@@ -190,6 +190,22 @@ def serve_main(hparams) -> dict:
     if specs and bus is not None:
         alert_engine = obs.AlertEngine(obs.parse_alert_specs(specs), bus=bus)
         bus.subscribe(alert_engine.observe_event)
+    # closed-loop autopilot for the serving path (ops/policy.py): the one
+    # action that lives HERE is rewarm_serve — a post-warmup recompile
+    # storm (the sentinel alert above) re-runs warmup() on the affected
+    # bucket subset, turning the compile cliff back into a warmed ladder.
+    policy_engine = None
+    if bus is not None:
+        from ..ops import policy as policy_mod
+
+        policy_engine = policy_mod.engine_from_hparams(
+            hparams, bus=bus, log=logger.warning
+        )
+    if policy_engine is not None:
+        policy_engine.bind(
+            "rewarm_serve", lambda decision: engine.rewarm()
+        )
+        bus.subscribe(policy_engine.observe_event)
     exporter = obs.start_exporter(
         getattr(hparams, "metrics_port", 0),
         registry=registry,
@@ -231,6 +247,8 @@ def serve_main(hparams) -> dict:
             exporter.close()
         if alert_engine is not None and bus is not None:
             bus.unsubscribe(alert_engine.observe_event)
+        if policy_engine is not None and bus is not None:
+            bus.unsubscribe(policy_engine.observe_event)
     metrics.log_summary(logger)
     report["engine"] = engine.stats()
     if bus is not None:

@@ -157,6 +157,11 @@ class ServeEngine:
         # analysis, and a bucket compiled after warmup() — the serve
         # bucket-churn failure mode — trips the recompilation sentinel
         self._monitor = monitor
+        # re-warm bookkeeping (ops/policy.py rewarm_serve): buckets that
+        # compiled AFTER warmup() — the recompile storm's footprint, and
+        # the subset rewarm() reports having closed
+        self._warmed = False
+        self._recompiled: set[int] = set()
 
     # ------------------------------------------------------------ program
     def _forward(self, variables, images_u8):
@@ -215,6 +220,11 @@ class ServeEngine:
         entry = (exe, rec)
         self._compiled[bucket] = entry
         self.compile_count += 1
+        if self._warmed:
+            # a compile cliff in the middle of live serving: remember the
+            # bucket so a rewarm_serve policy action knows the affected
+            # subset (the sentinel event already fired via the monitor)
+            self._recompiled.add(bucket)
         return entry
 
     # ------------------------------------------------------------- public
@@ -255,8 +265,47 @@ class ServeEngine:
                         (b, self.image_size, self.image_size, 3), np.uint8
                     )
                 )
+            self._warmed = True
         if self._monitor is not None:
             self._monitor.warm()
+
+    @property
+    def recompiled_buckets(self) -> tuple:
+        """Buckets compiled after ``warmup()`` — the recompile storm's
+        footprint (cleared by ``rewarm``)."""
+        with self._lock:
+            return tuple(sorted(self._recompiled))
+
+    def rewarm(self, buckets: Sequence[int] | None = None) -> dict:
+        """The ``rewarm_serve`` policy action: after a post-warmup
+        recompile storm, re-run ``warmup()`` on the affected bucket
+        subset — the buckets that compiled mid-serving plus any ladder
+        buckets still cold (the storm's lesson is that traffic reaches
+        them) — and re-arm the recompilation sentinel.  Explicit
+        ``buckets`` override the derived subset.  Returns what was done,
+        folded into the ``policy`` event's ``completed`` payload."""
+        with self._lock:
+            affected = sorted(self._recompiled)
+            cold = [b for b in self.buckets if b not in self._compiled]
+            targets = (
+                sorted({int(b) for b in buckets})
+                if buckets is not None
+                else sorted({*affected, *cold})
+            )
+            self._recompiled.clear()
+            # the re-warm's own compiles are the REMEDY, not more storm:
+            # un-arm while warmup() runs (it re-arms at its end)
+            self._warmed = False
+        if targets:
+            self.warmup(targets)
+        else:
+            with self._lock:
+                self._warmed = True
+            if self._monitor is not None:
+                # nothing to compile, but the sentinel re-arms: the storm
+                # is acknowledged and the next cliff is a new finding
+                self._monitor.warm()
+        return {"warmed": targets, "recompiled": affected}
 
     def _run_bucket(self, images: np.ndarray) -> np.ndarray:
         """Run one <=max_bucket chunk: pad to its bucket, execute, unpad."""

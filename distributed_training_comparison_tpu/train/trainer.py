@@ -733,24 +733,32 @@ class Trainer:
             elastic_msg = elastic.describe_restore(manifest, self.mesh)
             if elastic_msg:
                 self.logger.info(elastic_msg)
-            if (
-                manifest
-                and manifest.get("quarantined")
-                and hasattr(self.train_loader, "quarantine")
-            ):
-                # corrupt-shard quarantine survives the relaunch: re-apply
-                # the manifest's excluded example ids to the fresh loader
-                try:
-                    n = self.train_loader.quarantine(manifest["quarantined"])
-                except ValueError as e:
-                    self.logger.error(
-                        f"health: persisted quarantine not re-applied: {e}"
-                    )
-                else:
-                    self.logger.info(
-                        f"health: re-applied persisted quarantine "
-                        f"({n} example(s) excluded)"
-                    )
+            if manifest is not None and hasattr(self.train_loader, "quarantine"):
+                # corrupt-shard quarantine survives the relaunch: the
+                # manifest carries rank 0's excluded example ids, the
+                # per-rank quarantine-p*.json sidecars next to the
+                # checkpoint carry every OTHER rank's — union them all, so
+                # a multi-host relaunch (possibly onto a different world
+                # size) re-applies the whole fleet's set, not one shard's
+                from ..resilience.ckpt_io import union_quarantine
+
+                merged = union_quarantine(
+                    Path(hparams.resume).parent,
+                    manifest.get("quarantined"),
+                )
+                if merged:
+                    try:
+                        n = self.train_loader.quarantine(merged)
+                    except ValueError as e:
+                        self.logger.error(
+                            f"health: persisted quarantine not re-applied: {e}"
+                        )
+                    else:
+                        self.logger.info(
+                            f"health: re-applied persisted quarantine "
+                            f"({n} example(s) excluded, "
+                            f"{len(merged)} fleet-wide)"
+                        )
             if manifest and manifest.get("epoch_in_progress") == self.start_epoch:
                 # both data modes fast-forward exactly: the loader order and
                 # the per-step keys (host mode) / the epoch permutation and
@@ -910,6 +918,50 @@ class Trainer:
             heartbeats=self.heartbeat,
             alerts=self.alert_engine,
         )
+        # --- closed-loop autopilot (ops/policy.py).  Two shapes:
+        # unsupervised runs own a full in-process engine (fed by the same
+        # bus tap as the in-process alert engine) whose rollback/abort
+        # executors DEFER to the epoch boundary — the one point where the
+        # whole fleet is aligned and the rollback collectives can run;
+        # supervised runs instead poll the supervisor's request channel
+        # (<ckpt>/fleet/policy-*.req) there, because the supervisor is the
+        # one evaluating the alerts.  drain_host/rewarm_serve have no
+        # trainer-side executor (the fleet and the serve session own them).
+        self.policy_engine = None
+        self._policy_poller = None
+        self._policy_requests: list[dict] = []
+        if getattr(hparams, "policy", None):
+            from ..ops import policy as policy_mod
+
+            if os.environ.get(obs.RUN_ID_ENV) is None:
+                self.policy_engine = policy_mod.engine_from_hparams(
+                    hparams,
+                    bus=self.bus,
+                    # late-bound: _setup_obs runs before the logger exists,
+                    # and decisions only ever fire once training does
+                    log=lambda msg: self.logger.warning(msg),
+                )
+                if self.policy_engine is not None:
+                    self.policy_engine.bind_actions(
+                        {
+                            "rollback": self._policy_defer,
+                            "abort_with_evidence": self._policy_defer,
+                        }
+                    )
+                    self.bus.subscribe(self.policy_engine.observe_event)
+            elif getattr(hparams, "ckpt_path", None) and (
+                getattr(hparams, "policy_mode", "dry-run") != "off"
+            ):
+                self._policy_poller = policy_mod.PolicyRequestPoller(
+                    hparams.ckpt_path
+                )
+
+    def _policy_defer(self, decision: dict) -> dict:
+        """In-process executor for rollback/abort: queue the decision for
+        the next epoch boundary (the rollback path runs collectives every
+        process must enter together; acting mid-tap would not be safe)."""
+        self._policy_requests.append(dict(decision))
+        return {"deferred": True}
 
     def _obs_tick(self, *, epoch: int, step: int) -> None:
         """The per-chunk-boundary observability work: one heartbeat (rate-
@@ -967,9 +1019,9 @@ class Trainer:
         corrupt-shard quarantine rides along too — a supervisor relaunch
         must re-apply it, or the quarantined examples re-enter the stream
         and re-fire the very rollback the quarantine exists to stop.
-        (Multi-host caveat: only process 0 writes the manifest, so only
-        its shard's set survives a relaunch — acceptable for the opt-in
-        flag; noted in ROADMAP.)"""
+        (Multi-host: the manifest still carries process 0's set — the
+        back-compat field — while every rank, 0 included, persists its
+        own in a quarantine-p{i}.json sidecar; restore unions them.)"""
         meta = {
             **elastic.mesh_meta(self.mesh),
             "run_id": self.bus.run_id,
@@ -1168,6 +1220,18 @@ class Trainer:
                 # state, but without the watchdog there is no recovery
                 # policy — abort exactly like the pre-guard divergence check
                 self._abort_nonfinite(epoch, losses)
+
+            # closed-loop autopilot (ops/policy.py): apply any deferred
+            # policy actions at this boundary — rollback/abort decisions
+            # queued by the in-process engine's bus tap, or requests the
+            # supervisor's engine wrote to <ckpt>/fleet/policy-*.req.
+            # After the health check (the watchdog's own verdict has
+            # priority) and BEFORE this epoch validates or checkpoints, so
+            # a policy rollback never blesses the state it is revoking.
+            policy_next = self._apply_policy_requests(epoch, epoch_time)
+            if policy_next is not None:
+                epoch = policy_next
+                continue
 
             step_base = self._epoch_step_base
             meter = AverageMeter()
@@ -1748,6 +1812,17 @@ class Trainer:
                     f"step window {bad_steps[:8]} of epoch {epoch}; the "
                     "replay substitutes clean examples"
                 )
+                # persist THIS rank's set next to the checkpoints: the
+                # manifest (rank 0's write) carries only rank 0's shard,
+                # so every rank drops a quarantine-p{i}.json sidecar and a
+                # relaunch unions them all back (union_quarantine)
+                from ..resilience.ckpt_io import write_quarantine_sidecar
+
+                write_quarantine_sidecar(
+                    self._obs_dir or self.version_dir,
+                    jax.process_index(),
+                    self.train_loader.quarantined,
+                )
         self._resume_step_offset = 0  # a rollback replays whole epochs
         wasted_epochs = max(1, epoch - next_epoch + 1)
         wasted_s = self.goodput.transfer(
@@ -1766,6 +1841,161 @@ class Trainer:
         if self.is_main:
             self.watchdog.flush_events(self.version_dir)
         return next_epoch
+
+    # ---------------------------------------------------------- autopilot
+
+    def _apply_policy_requests(
+        self, epoch: int, epoch_time: float
+    ) -> int | None:
+        """Apply deferred policy actions at an epoch boundary.
+
+        Sources: the in-process engine's queued decisions (unsupervised
+        runs) and the supervisor's request files (supervised — process 0
+        polls; under multi-host the fold is allgather-OR'd so every
+        process enters the rollback collectives together, the
+        ``_preempt_due`` idiom).  Returns the epoch to re-enter after a
+        policy rollback, or None.  ``abort_with_evidence`` raises
+        :class:`~..ops.policy.PolicyAbort` after dumping the evidence.
+        """
+        if self.policy_engine is None and self._policy_poller is None:
+            return None
+        reqs, self._policy_requests = self._policy_requests, []
+        if self._policy_poller is not None and self.is_main:
+            # consume (read + unlink) HERE, where application immediately
+            # follows in the same call — a pickup earlier in the epoch
+            # would widen the window in which a crash loses a consumed-
+            # but-unapplied request to an unrecoverable pending state
+            reqs.extend(self._policy_poller.poll())
+        abort_reqs = [
+            r for r in reqs if r.get("action") == "abort_with_evidence"
+        ]
+        roll_reqs = [r for r in reqs if r.get("action") == "rollback"]
+        abort_req = abort_reqs[0] if abort_reqs else None
+        roll_req = roll_reqs[0] if roll_reqs else None
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            flags = np.any(
+                multihost_utils.process_allgather(
+                    np.asarray([abort_req is not None, roll_req is not None])
+                ),
+                axis=0,
+            )
+            # a peer received the request this process didn't see (only
+            # process 0 reads the file): act on the agreed decision, but
+            # leave completion emission to the process holding the id
+            if flags[0] and abort_req is None:
+                abort_req = {"action": "abort_with_evidence"}
+            if flags[1] and roll_req is None:
+                roll_req = {"action": "rollback"}
+        from ..ops import policy as policy_mod
+
+        if abort_req is not None:
+            # the abort supersedes everything else queued this boundary:
+            # close every OTHER id first (as 'coalesced' — the superseded
+            # actions were never performed) so no 'requested' event is
+            # left orphaned behind the raise
+            for r in abort_reqs[1:] + roll_reqs:
+                if r.get("id") is not None:
+                    policy_mod.emit_completion(
+                        self.bus, r, state="coalesced",
+                        coalesced_into=abort_req.get("id"),
+                    )
+            self._policy_abort_exit(epoch, abort_req)  # raises PolicyAbort
+        if roll_req is None:
+            return None
+
+        def fail(why: str) -> None:
+            self.logger.error(f"policy rollback not applied: {why}")
+            for r in roll_reqs:
+                if r.get("id") is not None:
+                    policy_mod.emit_completion(
+                        self.bus, r, ok=False, error=why
+                    )
+
+        if self.watchdog is None:
+            fail("the health watchdog is disabled (--no-health)")
+            return None
+        if self.watchdog.exhausted():
+            fail(
+                f"rollback budget "
+                f"({self.watchdog.cfg.max_rollbacks}) already exhausted"
+            )
+            return None
+        reason = f"policy action ({roll_req.get('rule') or 'rollback'})"
+        self.logger.warning(
+            f"policy: rollback requested at epoch {epoch}: {reason}"
+        )
+        with self.tracer.span("rollback", epoch=epoch):
+            next_epoch = self._rollback(epoch, epoch_time, reason)
+        if next_epoch is None:
+            fail("no verified rollback checkpoint available")
+            return None
+        # ONE rollback satisfies every request queued this boundary; each
+        # id gets its outcome so none reads as pending
+        for r in roll_reqs:
+            if r.get("id") is not None:
+                policy_mod.emit_completion(
+                    self.bus, r, from_epoch=epoch, to_epoch=next_epoch
+                )
+        return next_epoch
+
+    def _policy_abort_exit(self, epoch: int, req: dict) -> None:
+        """``abort_with_evidence``: drain the writer (the last good
+        checkpoint stays durable), attach the alert + policy timelines to
+        ``crash_dump.json`` next to the flight-recorder ring, and raise.
+        The supervisor's executor already asked the restart loop to stop,
+        so the evidence is the run's last word, not a relaunch input."""
+        from ..ops import policy as policy_mod
+
+        msg = (
+            f"policy abort_with_evidence at epoch {epoch} "
+            f"(rule {req.get('rule') or '?'}, trigger {req.get('trigger') or '?'})"
+        )
+        self.logger.error(msg)
+        if self.ckpt_writer is not None:
+            try:
+                self.ckpt_writer.wait()
+            except Exception as e:
+                self.logger.error(f"checkpoint writer error: {e}")
+        if req.get("id") is not None:
+            policy_mod.emit_completion(self.bus, req, epoch=epoch)
+        self.bus.emit("abort", epoch=epoch, reason=msg)
+        # the alert/policy timeline: this process's ring (the in-process
+        # engine emits here) plus the supervisor's root event file (a
+        # supervised run's engine lives over there)
+        timeline = [
+            ev for ev in self.bus.ring_events()
+            if ev.get("kind") in ("alert", "policy")
+        ]
+        root = getattr(self.hparams, "ckpt_path", None)
+        if self._policy_poller is not None and root:
+            try:
+                for path in sorted(Path(root).glob("events*.jsonl")):
+                    timeline.extend(
+                        ev for ev in obs.load_events(path)
+                        if ev.get("kind") in ("alert", "policy")
+                    )
+            except OSError:
+                pass
+        self.bus.dump_crash(
+            msg,
+            directory=self._obs_dir,
+            evidence={
+                "request": {
+                    k: req[k]
+                    for k in ("rule", "id", "trigger", "alert_source")
+                    if req.get(k) is not None
+                },
+                "alert_timeline": [
+                    ev for ev in timeline if ev.get("kind") == "alert"
+                ],
+                "policy_timeline": [
+                    ev for ev in timeline if ev.get("kind") == "policy"
+                ],
+            },
+        )
+        raise policy_mod.PolicyAbort(msg)
 
     # ------------------------------------------------------------- resilience
 
@@ -2329,6 +2559,8 @@ class Trainer:
         if self.alert_engine is not None:
             self.alert_engine.close()
             self.bus.unsubscribe(self.alert_engine.observe_event)
+        if self.policy_engine is not None:
+            self.bus.unsubscribe(self.policy_engine.observe_event)
         if self._obs_enabled and self._obs_dir is not None:
             obs.write_chrome_trace(
                 self._obs_dir

@@ -64,9 +64,21 @@ def emulate_host(hp, rank: int) -> int:
     """A non-zero rank at the file level: bind a per-process event bus into
     the run's version dir, heartbeat on the configured cadence, exit 0 when
     rank 0 finishes (its ``run_end``), 75 on SIGTERM (the drain a real host
-    would run), or by whatever signal kills the process."""
+    would run), or by whatever signal kills the process.
+
+    Chaos injection (resilience/faults.py ``EMU_SLOW_DISPATCH_ENV``): when
+    the env var is set, this host reports a persistently slowed
+    ``step/dispatch_s`` sketch — the straggler a ``--policy`` drain rule
+    must remove.  Emission waits for rank 0's first verified checkpoint so
+    the policy-driven drain always lands on a resumable run."""
     from distributed_training_comparison_tpu import obs
-    from distributed_training_comparison_tpu.resilience import EXIT_PREEMPTED
+    from distributed_training_comparison_tpu.resilience import (
+        EXIT_PREEMPTED,
+        read_manifest,
+    )
+    from distributed_training_comparison_tpu.resilience.faults import (
+        EMU_SLOW_DISPATCH_ENV,
+    )
 
     drained = {"flag": False}
     signal.signal(signal.SIGTERM, lambda *_: drained.__setitem__("flag", True))
@@ -91,6 +103,10 @@ def emulate_host(hp, rank: int) -> int:
     )
     bus.bind_dir(vdir)
     hb = obs.HeartbeatEmitter(bus, every_s=getattr(hp, "heartbeat_secs", 0.2))
+    slow_dispatch_s = float(os.environ.get(EMU_SLOW_DISPATCH_ENV, "0") or 0)
+    reg = obs.MetricRegistry(flush_steps=1) if slow_dispatch_s > 0 else None
+    straggling = False
+    last_straggle = 0.0
     step = 0
     events = vdir / "events.jsonl"  # rank 0's file: run_end says we're done
     try:
@@ -106,6 +122,22 @@ def emulate_host(hp, rank: int) -> int:
             break
         hb.beat(epoch=0, step=step)
         step += 1
+        if reg is not None:
+            if not straggling:
+                # hold the injection until rank 0 has a resumable state
+                straggling = (
+                    read_manifest(vdir / "last.ckpt") is not None
+                )
+            if straggling and time.monotonic() - last_straggle > 0.3:
+                last_straggle = time.monotonic()
+                # one flushed window of pathologically slow dispatch: the
+                # per-process p95 alert on this source fires after for=N
+                # windows, and the policy names THIS host for the drain
+                reg.histogram("step/dispatch_s").record_many(
+                    [slow_dispatch_s] * 4
+                )
+                reg.note_steps(4)
+                reg.flush(bus, epoch=0, step=step)
         try:
             with open(events, "rb") as f:
                 f.seek(offset)

@@ -10,6 +10,9 @@ Usage::
     python tools/run_report.py CKPT_ROOT --blackbox   # decode flight rings
     python tools/run_report.py CKPT_ROOT --alerts     # alert timeline; rc=1
                                                       # while any rule fires
+    python tools/run_report.py CKPT_ROOT --policy     # autopilot decision
+                                                      # timeline; rc=1 on any
+                                                      # action still pending
     python tools/run_report.py CKPT_ROOT --compute    # per-executable
                                                       # cost/memory/MFU table
     python tools/run_report.py CKPT_ROOT --export-openmetrics [OUT]
@@ -823,6 +826,71 @@ def alerts_report(path: str | Path, out=print) -> int:
     return 0
 
 
+# ------------------------------------------------------------------ policy
+
+
+def policy_report(path: str | Path, out=print) -> int:
+    """The ``--policy`` view: every autopilot decision under ``path`` as a
+    timeline — dry-runs, cooldown/budget suppressions, requested actions
+    and their completions.  Exit 0 when every requested action reached a
+    ``completed``/``failed`` outcome (including when there are no policy
+    events at all — a run without ``--policy`` rules is not unhealthy),
+    1 while any action is still PENDING (requested by the engine but
+    never applied — the process meant to apply it died first), 2 when
+    ``path`` holds no events whatsoever."""
+    from distributed_training_comparison_tpu.ops.policy import (
+        pending_actions,
+        policy_timeline,
+    )
+
+    events, _files = load_run(path)
+    if not events:
+        out(f"{path}: no events found")
+        return 2
+    timeline = policy_timeline(events)
+    if not timeline:
+        out(f"{path}: no policy events (no --policy rules, or none ever "
+            "triggered)")
+        return 0
+    t0 = events[0].get("t_wall", 0.0)
+    for ev in timeline:
+        p = ev.get("payload") or {}
+        state = p.get("state", "?")
+        line = (
+            f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s] "
+            f"{state.upper():>9}: {p.get('action', '?')}"
+        )
+        if p.get("rule"):
+            line += f"  rule={p['rule']}"
+        if p.get("alert_source") is not None:
+            line += f" source={p['alert_source']}"
+        if p.get("id") is not None:
+            line += f" id={p['id']}"
+        if state == "cooldown":
+            line += f" ({p.get('cooldown_remaining_s', '?')}s remaining)"
+        if state == "budget":
+            line += (
+                f" ({p.get('budget_spent', '?')}/{p.get('budget', '?')} spent)"
+            )
+        if state == "failed" and p.get("error"):
+            line += f" error={p['error']}"
+        if p.get("dry_run") and state == "dry_run":
+            line += "  [no action taken]"
+        out(line)
+    pending = pending_actions(events)
+    if pending:
+        out(
+            "STILL PENDING: "
+            + ", ".join(
+                f"{p.get('action', '?')} (id {p.get('id', '?')})"
+                for p in pending
+            )
+        )
+        return 1
+    out("all requested actions completed")
+    return 0
+
+
 def export_openmetrics(path: str | Path, out_path: str | None = None) -> str:
     """The scrape-less exposition: fold a finished (or in-flight) run's
     ``metrics`` events — plus the serve records' latency deltas — into
@@ -1263,6 +1331,13 @@ def main(argv: list[str]) -> int:
         "exit 1 while any rule is still firing — the CI gate",
     )
     ap.add_argument(
+        "--policy", action="store_true",
+        help="print the autopilot decision timeline (ops/policy.py: "
+        "dry-runs, cooldown/budget suppressions, actions and their "
+        "completions); exit 1 while any requested action is still "
+        "pending — the chaos-gauntlet gate",
+    )
+    ap.add_argument(
         "--export-openmetrics", metavar="OUT", default=None, nargs="?",
         const="-",
         help="render the run's merged metrics/heartbeats/alerts in the "
@@ -1297,6 +1372,12 @@ def main(argv: list[str]) -> int:
         rc = 0
         for path in args.paths:
             rc = max(rc, alerts_report(path))
+        return rc
+
+    if args.policy:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, policy_report(path))
         return rc
 
     if args.export_openmetrics is not None:
