@@ -407,12 +407,54 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--device-prefetch",
-        type=int,
-        default=DEVICE_PREFETCH_DEFAULT,
+        type=str,
+        default=str(DEVICE_PREFETCH_DEFAULT),
         help="host data mode: staged device chunks the background H2D "
         "thread keeps in flight ahead of the running dispatch (bounds the "
         "extra HBM at N chunk buffers; transfer hides behind compute). "
-        "0 = synchronous staging on the main thread (the pre-overlap path)",
+        "0 = synchronous staging on the main thread (the pre-overlap "
+        "path). 'auto' = derive the depth PER HOST from this host's free "
+        "HBM headroom (parallel/planner.py auto_staging_depth) — a "
+        "straggler host with less headroom stages shallower locally "
+        "instead of stalling the collective dispatch at a fleet-global "
+        "constant; backends without memory stats keep the default "
+        f"({DEVICE_PREFETCH_DEFAULT})",
+    )
+    parser.add_argument(
+        "--parallel-plan",
+        type=str,
+        default="off",
+        choices=["off", "auto", "dump"],
+        help="Ledger-fit auto-parallel planner (parallel/planner.py): "
+        "enumerate DP×TP×PP(×virtual-stage)×--shard-optim×--grad-comms "
+        "layouts, feasibility-filter through the existing gates, score "
+        "with a cost model fit to the compile-event ledger under "
+        "--ckpt-path, and 'auto' = install the fastest legal layout at "
+        "trainer construction (overriding hand-picked layout flags; "
+        "--grad-comms stays the numerics ceiling — the planner never "
+        "compresses below what the flag authorized). 'dump' = score and "
+        "log the candidate table but run the hand-picked flags. Every "
+        "decision is one registered 'plan' event; run_report --plan "
+        "renders prediction vs measured and fails a stream whose "
+        "installed plan disagrees with the run_start layout. Under "
+        "--supervise --fleet-hosts the supervisor re-plans at every "
+        "attempt boundary, so a fleet resize lands on the fastest legal "
+        "layout rather than the widest, and the autopilot's 'replan' "
+        "policy action can force a fresh plan off an HBM-ledger alert",
+    )
+    parser.add_argument(
+        "--ckpt-comms-residual",
+        action=argparse.BooleanOptionalAction,
+        default=False,
+        help="Checkpoint the --grad-comms error-feedback residual in "
+        "last.ckpt (the manifest records its presence), so resume keeps "
+        "the compression error the wire already dropped instead of "
+        "restarting it at zero. Cross-flag restores (saved with, "
+        "restoring without — or the wire layout changed) keep the "
+        "documented drop-and-warn path; rollback always resets the "
+        "residual (it belonged to the discarded trajectory). Off by "
+        "default: the residual costs a params-sized fetch per save for "
+        "at most one step's quantization error",
     )
     parser.add_argument(
         "--profile-dir",
@@ -917,7 +959,19 @@ def load_config(
         parser.error(
             f"--device-chunk-steps must be >= 0, got {args.device_chunk_steps}"
         )
-    if args.device_prefetch < 0:
+    # --device-prefetch: an int depth, or 'auto' (per-host HBM-derived)
+    if isinstance(args.device_prefetch, str):
+        if args.device_prefetch.strip().lower() == "auto":
+            args.device_prefetch = "auto"
+        else:
+            try:
+                args.device_prefetch = int(args.device_prefetch)
+            except ValueError:
+                parser.error(
+                    f"--device-prefetch must be an integer >= 0 or 'auto', "
+                    f"got {args.device_prefetch!r}"
+                )
+    if args.device_prefetch != "auto" and args.device_prefetch < 0:
         parser.error(
             f"--device-prefetch must be >= 0, got {args.device_prefetch}"
         )

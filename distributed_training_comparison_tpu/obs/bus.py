@@ -90,6 +90,12 @@ KNOWN_KINDS = {
     # chaos gauntlet (resilience/faults scenario catalog + bench --chaos):
     # one event per named scenario with its outcome counts
     "chaos",
+    # auto-parallel planner (parallel/planner): one event per planning
+    # decision — chosen layout + flags, every candidate's predicted
+    # step-s/HBM, refusal counts, and the cost-model fit provenance;
+    # run_report --plan fails a stream whose installed plan disagrees
+    # with the attempt's run_start layout
+    "plan",
 }
 
 

@@ -43,6 +43,16 @@ metric).  Actions:
                     policy timelines are attached to ``crash_dump.json``,
                     and a supervising restart loop stops instead of
                     relaunching a regressed run.
+``replan``          drain the running fleet attempt deliberately and
+                    re-run the auto-parallel planner at the next boundary
+                    against the freshest ledger (``parallel/planner.py``)
+                    — the HBM-ledger-breach remediation: the breach's own
+                    gauges are in the ledger the re-plan fits, so the new
+                    layout lands under the footprint gate.  Needs
+                    ``--parallel-plan auto`` under an elastic fleet with
+                    a known ``--fleet-local-devices``; the replan drain
+                    is budget-free supervisor work (the policy cooldown/
+                    budget already rate-limit it).
 ==================  ====================================================
 
 Every decision — suppressed or acted — emits one registered ``policy``
@@ -81,7 +91,10 @@ from pathlib import Path
 
 POLICY_KIND = "policy"
 
-ACTIONS = ("drain_host", "rewarm_serve", "rollback", "abort_with_evidence")
+ACTIONS = (
+    "drain_host", "rewarm_serve", "rollback", "abort_with_evidence",
+    "replan",
+)
 MODES = ("off", "dry-run", "act")
 DEFAULT_COOLDOWN_S = 60.0
 MAX_ACTIONS_DEFAULT = 4
@@ -561,6 +574,7 @@ def emit_completion(
 
 def supervisor_actions(
     ckpt_root, *, fleet_hosts: int = 0, request_stop=None,
+    request_replan=None,
 ) -> dict:
     """The supervisor-side executor set.
 
@@ -630,10 +644,28 @@ def supervisor_actions(
             return {"coalesced": True}
         return {"deferred": True}
 
+    def replan(decision: dict) -> dict:
+        # drain + re-plan at the next attempt boundary (FleetSupervisor
+        # .request_replan) — the fresh plan fits the ledger that now
+        # carries the breaching HBM gauges, so an hbm-alert rule lands
+        # the fleet on a layout under the footprint gate
+        if request_replan is None:
+            raise PolicyActionError(
+                "replan needs an elastic fleet running --parallel-plan "
+                "auto with a known --fleet-local-devices"
+            )
+        reason = (
+            f"policy rule {decision.get('rule')!r} "
+            f"(alert {decision.get('trigger')!r})"
+        )
+        request_replan(reason)
+        return {"reason": reason}
+
     return {
         "drain_host": drain_host,
         "rollback": rollback,
         "abort_with_evidence": abort_with_evidence,
+        "replan": replan,
     }
 
 

@@ -69,6 +69,21 @@ _PARENT_FLAGS = (
     "--fleet-hosts", "--fleet-min-hosts", "--fleet-local-devices",
     "--fleet-grace-secs", "--fleet-poll-secs",
 )
+# layout flags the supervisor's auto-parallel plan re-renders per attempt
+# (value-taking vs bare, because strip_flags assumes `--flag VALUE` pairs)
+_PLAN_VALUE_FLAGS = (
+    "--model-parallel", "--pipeline-parallel", "--pipeline-virtual-stages",
+    "--pipeline-schedule", "--pipeline-microbatches", "--grad-comms",
+    "--parallel-plan",
+    # the plan owns the whole layout: a surviving legacy --parallel-style
+    # would either parser.error() every child (pipeline-parallel > 1
+    # composes with style tensor only) or silently run the legacy
+    # single-axis pipeline the cost model never priced; stripping it
+    # leaves the child on the default tensor-compose style the candidates
+    # were scored as
+    "--parallel-style",
+)
+_PLAN_BARE_FLAGS = ("--shard-optim", "--no-shard-optim")
 
 
 class FleetPlanError(PlanRefused):
@@ -89,7 +104,7 @@ def free_rendezvous_port() -> int:
         return s.getsockname()[1]
 
 
-def widest_legal_world(
+def legal_worlds(
     n_hosts: int,
     *,
     batch_size: int = 0,
@@ -97,10 +112,13 @@ def widest_legal_world(
     model_parallel: int = 1,
     pipeline_parallel: int = 1,
     grad_accum: int = 1,
-) -> int | None:
-    """The widest world size ``W <= n_hosts`` whose mesh and batch split
-    are legal: ``W * local_devices`` devices must tile the model axis, and
-    the global batch must divide the resulting data axis x grad_accum.
+) -> list[int]:
+    """Every world size ``W <= n_hosts`` whose mesh and batch split are
+    legal, widest first: ``W * local_devices`` devices must tile the
+    model axis, and the global batch must divide the resulting data axis
+    x grad_accum.  This is the FEASIBILITY filter — the auto-parallel
+    planner scores each legal world and picks the fastest; without a
+    planner the widest wins (:func:`widest_legal_world`).
 
     ``local_devices == 0`` (unknown per-host device count — real TPU
     hosts inheriting their environment) DEGRADES the check rather than
@@ -109,11 +127,12 @@ def widest_legal_world(
     ``local=1`` would wrongly refuse), and host-granularity batch
     divisibility is only a *necessary* condition when the model axis is 1.
     The Trainer's own ``elastic.validate_reshard`` stays the authority at
-    restore time.  Returns None when no W in ``[1, n_hosts]`` is legal."""
+    restore time."""
     from ..parallel.mesh import elastic_mesh_shape
 
     local = int(local_devices)
     unit = max(1, grad_accum)
+    out: list[int] = []
     for w in range(int(n_hosts), 0, -1):
         if local > 0:
             shape = elastic_mesh_shape(
@@ -129,8 +148,30 @@ def widest_legal_world(
             if batch_size and batch_size % (w * unit):
                 continue
         # unknown devices/host with a model axis: any W may be legal
-        return w
-    return None
+        out.append(w)
+    return out
+
+
+def widest_legal_world(
+    n_hosts: int,
+    *,
+    batch_size: int = 0,
+    local_devices: int = 0,
+    model_parallel: int = 1,
+    pipeline_parallel: int = 1,
+    grad_accum: int = 1,
+) -> int | None:
+    """The widest legal world (see :func:`legal_worlds`), or None when no
+    W in ``[1, n_hosts]`` is legal."""
+    worlds = legal_worlds(
+        n_hosts,
+        batch_size=batch_size,
+        local_devices=local_devices,
+        model_parallel=model_parallel,
+        pipeline_parallel=pipeline_parallel,
+        grad_accum=grad_accum,
+    )
+    return worlds[0] if worlds else None
 
 
 class FleetSupervisor(Supervisor):
@@ -164,6 +205,7 @@ class FleetSupervisor(Supervisor):
         poll_s: float = 0.5,
         spawn=None,
         coordinator_host: str = "127.0.0.1",
+        plan_hparams=None,
         **kw,
     ) -> None:
         super().__init__(cmd, **kw)
@@ -195,6 +237,23 @@ class FleetSupervisor(Supervisor):
         self._attempt = 0
         self._deliberate: str | None = None  # planned drain reason, one-shot
         self._change: dict[str, list[int]] = {"lost": [], "returned": []}
+        # --- auto-parallel planning (--parallel-plan auto under the
+        # fleet): the supervisor re-plans at EVERY attempt boundary, so a
+        # resize lands on the fastest legal layout rather than the widest
+        # (legal_worlds is the feasibility filter; the planner the
+        # decision).  Requires a known per-host device count — with
+        # local_devices == 0 the supervisor cannot size candidate meshes
+        # and planning degrades to the children's own trainer-side plan.
+        self.plan_hparams = (
+            plan_hparams
+            if plan_hparams is not None
+            and str(getattr(plan_hparams, "parallel_plan", "off")) == "auto"
+            and self.local_devices > 0
+            else None
+        )
+        self.plans: list[dict] = []  # one payload per emitted plan event
+        self._plan_flags: list[str] = []  # rendered layout for this attempt
+        self._replan_reason: str | None = None  # policy 'replan' request
 
     # ------------------------------------------------------------- pool
 
@@ -249,6 +308,68 @@ class FleetSupervisor(Supervisor):
 
     # ------------------------------------------------------------- plan
 
+    def _plan_world(
+        self, n_active: int, events: list | None = None
+    ) -> tuple[int | None, object | None, list[str]]:
+        """Score every legal world size with the auto-parallel planner
+        and return ``(world, plan, errors)`` — the fastest predicted
+        (W, layout), ties broken toward the WIDER world.
+
+        ``legal_worlds`` in its host-granularity form (``local_devices=0,
+        model_parallel=1``: batch % hosts × grad_accum — the condition
+        every child hard-enforces via ``host_local_batch_slice`` whatever
+        mesh the plan installs) is the feasibility frame; each world's
+        per-candidate mesh/batch/HBM gates run inside ``plan_layout``,
+        so the refusal strings carry the actual numbers
+        (``elastic.divisibility_help``)."""
+        from ..parallel import planner as planner_mod
+
+        if events is None:
+            events = planner_mod.load_ledger_events(self.ckpt_root)
+        # ONE ledger fold for every candidate world (the event history of
+        # a long elastic run is large; per-world re-parsing would pay
+        # O(hosts x stream) at every boundary).  The supervisor process
+        # never touches accelerators — the device kind comes from the
+        # children's committed compile events, never from initializing a
+        # jax backend in the parent.
+        ledger = planner_mod.fit_ledger(events)
+        kind = ledger.device_kind or "unknown"
+        unit = max(1, self.grad_accum)
+        # the host-granularity feasibility frame (see docstring)
+        legal = set(
+            legal_worlds(
+                n_active, batch_size=self.batch_size,
+                local_devices=0, model_parallel=1, pipeline_parallel=1,
+                grad_accum=self.grad_accum,
+            )
+        )
+        best: tuple | None = None
+        errors: list[str] = []
+        for w in range(int(n_active), max(1, self.min_hosts) - 1, -1):
+            if w not in legal:
+                errors.append(
+                    f"world {w}: global batch {self.batch_size} not "
+                    f"divisible by {w} host(s)"
+                    + (f" x grad_accum {unit}" if unit > 1 else "")
+                )
+                continue
+            try:
+                p = planner_mod.plan_layout(
+                    self.plan_hparams,
+                    devices=w * self.local_devices,
+                    device_kind=kind,
+                    ledger=ledger,
+                )
+            except planner_mod.PlanError as e:
+                errors.append(f"world {w}: {e}")
+                continue
+            key = (p.predicted_step_s, -w)
+            if best is None or key < best[0]:
+                best = (key, w, p)
+        if best is None:
+            return None, None, errors
+        return best[1], best[2], errors
+
     def _plan_attempt(self, attempt: int) -> None:
         self._attempt = attempt
         self._poll_markers()
@@ -264,14 +385,25 @@ class FleetSupervisor(Supervisor):
             for host in self.lost_hosts():
                 self.readmit(host)
         active = self.active_hosts()
-        world = widest_legal_world(
-            len(active),
-            batch_size=self.batch_size,
-            local_devices=self.local_devices,
-            model_parallel=self.model_parallel,
-            pipeline_parallel=self.pipeline_parallel,
-            grad_accum=self.grad_accum,
-        )
+        replan_reason, self._replan_reason = self._replan_reason, None
+        plan = None
+        plan_errors: list[str] = []
+        world = None
+        if self.plan_hparams is not None:
+            # the planner decides; legal_worlds/widest_legal_world stay
+            # the feasibility frame.  A failed plan at every world falls
+            # through to the classic widest-legal selection so the
+            # refusal path still names the real blocker.
+            world, plan, plan_errors = self._plan_world(len(active))
+        if world is None:
+            world = widest_legal_world(
+                len(active),
+                batch_size=self.batch_size,
+                local_devices=self.local_devices,
+                model_parallel=self.model_parallel,
+                pipeline_parallel=self.pipeline_parallel,
+                grad_accum=self.grad_accum,
+            )
         if world is None or world < self.min_hosts:
             from ..parallel.mesh import elastic_mesh_shape
             from .elastic import divisibility_help
@@ -309,6 +441,11 @@ class FleetSupervisor(Supervisor):
                     detail = divisibility_help(
                         self.batch_size, shape[0], self.grad_accum
                     )
+            if plan_errors:
+                # the planner's per-world refusals carry the same
+                # actionable numbers (divisibility_help & friends) —
+                # surface the widest world's, not a bare "no plan found"
+                detail = f"{detail}; planner: {plan_errors[0]}"
             msg = (
                 f"no legal world size for {len(active)} surviving host(s) "
                 f"(hosts alive: {active}, {local} device(s)/host, "
@@ -343,14 +480,71 @@ class FleetSupervisor(Supervisor):
                 f"ranks on hosts {self._ranks})"
             )
         self._change = {"lost": [], "returned": []}
+        # one `plan` event per planned attempt, AFTER any resize — a
+        # shrink's stream reads resize → plan → run_start, and run_report
+        # --plan checks the run_start layout against this payload
+        if plan is not None:
+            plan_reason = (
+                "policy_replan"
+                if replan_reason
+                else ("resize" if prev is not None and world != prev
+                      else "attempt_plan")
+            )
+            payload = plan.payload(
+                installed=True, reason=plan_reason, attempt=attempt
+            )
+            # the host count this plan sized its devices from: run_report
+            # --plan scales the data-axis check by the world the attempt
+            # actually joined (the pid-level CPU fleet emulation's rank 0
+            # runs its own local world; on a real pod worlds agree and
+            # the check is exact)
+            payload["world"] = world
+            if replan_reason:
+                payload["replan_trigger"] = replan_reason
+            self.plans.append(
+                {
+                    "attempt": attempt,
+                    "reason": plan_reason,
+                    "world": world,
+                    "chosen": plan.chosen.key,
+                    "predicted_step_s": plan.chosen.predicted_step_s,
+                }
+            )
+            self._events("plan", **payload)
+            self._plan_flags = plan.chosen.flags() + ["--parallel-plan", "off"]
+            self._log(
+                f"plan: attempt {attempt} world {world} -> "
+                f"{plan.chosen.key} (predicted step "
+                f"{plan.predicted_step_s:.6f}s, {plan_reason})"
+            )
+        else:
+            self._plan_flags = []
 
     def _attempt_info(self) -> dict:
         return {"world_size": self._world, "hosts": list(self._ranks)}
 
     def _attempt_free(self, rc: int, preempted: bool) -> bool:
-        # the deliberate drain-and-re-expand is planned work: consuming the
-        # restart budget for it would starve real failures of restarts
-        return self._deliberate == "host_returned"
+        # the deliberate drain-and-re-expand — and the autopilot's replan
+        # drain — are planned work: consuming the restart budget for them
+        # would starve real failures of restarts (the policy engine's own
+        # cooldown + action budget already bound how often replan fires)
+        return self._deliberate in ("host_returned", "replan")
+
+    def request_replan(self, reason: str) -> None:
+        """The autopilot's ``replan`` action (ops/policy.py): drain the
+        running attempt deliberately and re-plan the layout at the next
+        boundary against the freshest ledger — the HBM-breach remediation
+        the PR-12 autopilot had no action for.  Thread-safe one-shot (the
+        policy engine calls from the watcher thread; the launch poll loop
+        reads it); first reason wins until the next plan consumes it."""
+        if self.plan_hparams is None:
+            raise ValueError(
+                "replan needs --parallel-plan auto with a known "
+                "--fleet-local-devices (supervisor-side planning is off)"
+            )
+        if self._replan_reason is None:
+            self._replan_reason = str(reason)
+            self._log(f"replan requested: {reason}")
 
     # ----------------------------------------------------------- launch
 
@@ -358,6 +552,22 @@ class FleetSupervisor(Supervisor):
         self, base: Sequence[str], world: int, rank: int, port: int
     ) -> list[str]:
         args = strip_flags(base, _RENDERED_FLAGS + _PARENT_FLAGS)
+        if self._plan_flags:
+            # the supervisor's plan owns the layout: strip any caller
+            # layout flags and append the rendered winner (which ends
+            # with --parallel-plan off, so the child does not re-plan)
+            args = [a for a in args if a not in _PLAN_BARE_FLAGS]
+            args = strip_flags(args, _PLAN_VALUE_FLAGS)
+            args = args + list(self._plan_flags)
+        elif self.plan_hparams is not None:
+            # supervisor-side planning is on but this attempt fell back
+            # to the classic widest-legal selection (every world's plan
+            # refused): the caller's hand layout flags survive untouched,
+            # but the children must not re-plan — their own planner would
+            # re-raise the same refusal at Trainer construction and the
+            # fleet would burn its restart budget relaunching a crash
+            args = strip_flags(args, ("--parallel-plan",))
+            args = args + ["--parallel-plan", "off"]
         return args + [
             "--world-size", str(world),
             "--rank", str(rank),
@@ -488,6 +698,12 @@ class FleetSupervisor(Supervisor):
                     # world — a spare coming back that batch divisibility
                     # still excludes must not burn a drain-relaunch cycle
                     self._deliberate = "host_returned"
+                elif self._replan_reason is not None:
+                    # the autopilot asked for a replan (an HBM-ledger
+                    # alert fired): drain deliberately; _plan_attempt
+                    # consumes the reason and re-plans with the ledger
+                    # that now carries the breach
+                    self._deliberate = "replan"
                 if self._deliberate is not None:
                     self._log(
                         f"draining attempt {attempt} ({self._deliberate}): "
@@ -538,6 +754,10 @@ class FleetSupervisor(Supervisor):
         summary = super().run()
         summary["resizes"] = list(self.resizes)
         summary["hosts"] = {str(h): s for h, s in sorted(self.pool.items())}
+        if self.plan_hparams is not None:
+            # the compact plan ledger (full payloads live on the bus as
+            # `plan` events): one row per planned attempt
+            summary["plans"] = list(self.plans)
         return summary
 
 

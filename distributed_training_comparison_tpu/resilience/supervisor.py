@@ -445,6 +445,10 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
 
         sup = FleetSupervisor(
             cmd_for, env=env_for, ckpt_root=hparams.ckpt_path,
+            # --parallel-plan auto: the fleet re-plans the layout at every
+            # attempt boundary (resize → fresh plan; children get the
+            # rendered flags + --parallel-plan off so they don't re-plan)
+            plan_hparams=hparams,
             **fleet_env_knobs(hparams), **restart_policy,
         )
     else:
@@ -469,6 +473,13 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
                 hparams.ckpt_path,
                 fleet_hosts=fleet_hosts,
                 request_stop=sup.request_stop,
+                # the replan action exists only where a planner does: an
+                # elastic fleet with supervisor-side planning enabled
+                request_replan=(
+                    sup.request_replan
+                    if getattr(sup, "plan_hparams", None) is not None
+                    else None
+                ),
             )
         )
 

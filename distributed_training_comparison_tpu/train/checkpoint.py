@@ -115,19 +115,26 @@ def agreed_version_dir(ckpt_root: str | Path) -> Path:
 
 
 def _state_dict(state: TrainState) -> dict[str, Any]:
-    # comms_residual (the --grad-comms error-feedback carry) is
-    # deliberately excluded: checkpoints stay bit-compatible across every
-    # --shard-optim/--grad-comms combination, and a resumed run restarts
-    # the residual at zero (costs at most one step's quantization error).
-    # Sharded optimizer state needs nothing here either — fetch_to_host
-    # gathers full host arrays whatever the layout, and restore re-places
-    # them under the restoring run's shardings (the reshard step).
-    return {
+    # comms_residual (the --grad-comms error-feedback carry) serializes
+    # only when the state CARRIES one — the Trainer's _ckpt_view strips
+    # it unless --ckpt-comms-residual asked for it, so the default
+    # checkpoint stays bit-compatible across every --shard-optim/
+    # --grad-comms combination and a resumed run restarts the residual at
+    # zero (at most one step's quantization error).  load_resume_state
+    # reconciles the key across saved-with/restoring-without boundaries
+    # (the documented drop-and-warn path).  Sharded optimizer state needs
+    # nothing here either — fetch_to_host gathers full host arrays
+    # whatever the layout, and restore re-places them under the restoring
+    # run's shardings (the reshard step).
+    out = {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
     }
+    if state.comms_residual is not None:
+        out["comms_residual"] = state.comms_residual
+    return out
 
 
 # Device→host reads below go through fetch_to_host: shard-safe for
@@ -421,22 +428,67 @@ def save_resume_state(
 
 
 def load_resume_state(
-    path: str | Path, state: TrainState, raw_bytes: bytes | None = None
+    path: str | Path,
+    state: TrainState,
+    raw_bytes: bytes | None = None,
+    info: dict | None = None,
 ) -> tuple[TrainState, int, float]:
     """Restore ``(state, next_epoch, best_acc)`` from a ``last.ckpt``.
 
     ``raw_bytes`` lets a caller that already read the file (to verify its
     manifest) restore from the same buffer — one disk read of a possibly
-    multi-GB state instead of two."""
+    multi-GB state instead of two.
+
+    The comms error-feedback residual is reconciled across flag
+    boundaries (``--ckpt-comms-residual``): restored only when BOTH the
+    checkpoint carries one and the restoring state does, with matching
+    wire layout (tree + shapes) — any other combination keeps the
+    documented drop path (the caller resets to zeros and warns).
+    ``info``, when given, gains ``comms_residual``:
+    ``"restored"`` / ``"dropped:<why>"`` / ``"absent"``."""
     raw = serialization.msgpack_restore(
         raw_bytes if raw_bytes is not None else Path(path).read_bytes()
     )
     _check_ckpt_fmt(raw, state.params, path)
-    restored = serialization.from_state_dict(_state_dict(state), raw["state"])
+    template = _state_dict(state)
+    raw_state = dict(raw["state"])
+    saved_res = raw_state.pop("comms_residual", None)
+    want_res = template.pop("comms_residual", None) is not None
+    restored = serialization.from_state_dict(template, raw_state)
+    residual = None
+    note = "absent"
+    if saved_res is not None and want_res:
+        import jax  # lazy, like every other jax touch in this module
+
+        try:
+            candidate = serialization.from_state_dict(
+                state.comms_residual, saved_res
+            )
+            live_shapes = [
+                tuple(getattr(l, "shape", ()))
+                for l in jax.tree_util.tree_leaves(state.comms_residual)
+            ]
+            got_shapes = [
+                tuple(np.shape(l))
+                for l in jax.tree_util.tree_leaves(candidate)
+            ]
+            if live_shapes == got_shapes:
+                residual = candidate
+                note = "restored"
+            else:
+                note = "dropped:wire-layout-changed"
+        except (ValueError, KeyError, TypeError):
+            note = "dropped:wire-layout-changed"
+    elif saved_res is not None:
+        note = "dropped:grad-comms-off"
     state = state.replace(
         step=restored["step"],
         params=restored["params"],
         batch_stats=restored["batch_stats"],
         opt_state=restored["opt_state"],
     )
+    if residual is not None:
+        state = state.replace(comms_residual=residual)
+    if info is not None:
+        info["comms_residual"] = note
     return state, int(raw["epoch"]) + 1, float(raw["best_acc"])

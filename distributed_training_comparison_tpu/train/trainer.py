@@ -130,6 +130,47 @@ class Trainer:
         self._step_faults = (
             self.fault_plan is not None and self.fault_plan.has_step_faults()
         )
+        # --- auto-parallel planner (parallel/planner.py): with
+        # --parallel-plan auto the layout flags below (model_parallel /
+        # pipeline_parallel / shard_optim / grad_comms / the pipeline
+        # schedule knobs) are the PLANNER's output, installed here BEFORE
+        # the mesh/model/comms constructions read them.  The decision is
+        # one registered `plan` event (chosen layout, every candidate's
+        # predicted step-s/HBM, fit provenance) — run_report --plan fails
+        # the stream if run_start's layout disagrees with an installed
+        # plan.  'dump' scores and logs but keeps the hand-picked flags.
+        # An explicitly passed mesh wins (tests/embedders own the layout).
+        self.plan = None
+        self._plan_installed = False
+        self._plan_refusal = None
+        plan_mode = str(getattr(hparams, "parallel_plan", "off") or "off")
+        if plan_mode != "off" and mesh is None:
+            from ..parallel import planner as planner_mod
+
+            try:
+                self.plan = planner_mod.plan_layout(
+                    hparams,
+                    events=planner_mod.load_ledger_events(
+                        getattr(hparams, "ckpt_path", None)
+                    ),
+                    model=model,
+                )
+            except planner_mod.PlanError as e:
+                # dump's contract is "score and log, never gate": a
+                # refusal with legal hand flags must not kill the run —
+                # the refusal (with its numbers) is logged below instead.
+                # auto has nothing to install, so the refusal stands.
+                if plan_mode == "auto":
+                    raise
+                self._plan_refusal = str(e)
+            else:
+                self._plan_installed = plan_mode == "auto"
+                if self._plan_installed:
+                    planner_mod.install_plan(self.plan, hparams)
+                self.bus.emit(
+                    planner_mod.PLAN_KIND,
+                    **self.plan.payload(installed=self._plan_installed),
+                )
         self.mesh = mesh if mesh is not None else make_mesh(
             hparams.num_devices,
             hparams.model_parallel,
@@ -286,6 +327,14 @@ class Trainer:
         # gradient-sync wire, so the fwd_bwd build below needs the mode
         self.shard_optim = bool(getattr(hparams, "shard_optim", False))
         self.grad_comms = getattr(hparams, "grad_comms", "fp32") or "fp32"
+        # --ckpt-comms-residual: serialize the error-feedback residual in
+        # last.ckpt (manifest records presence) so resume keeps the
+        # compression error the wire already dropped.  Rollback always
+        # resets it regardless — a rolled-back residual belonged to the
+        # discarded trajectory.
+        self._ckpt_residual = bool(
+            getattr(hparams, "ckpt_comms_residual", False)
+        ) and self.grad_comms != "fp32"
         legacy_pipe = style == "pipeline" and mp_size > 1
         pipe_axis = "pipe" if pp_size > 1 else "model"
         pipe_size = pp_size if pp_size > 1 else (mp_size if legacy_pipe else 1)
@@ -530,6 +579,37 @@ class Trainer:
         self._device_prefetch = getattr(
             hparams, "device_prefetch", DEVICE_PREFETCH_DEFAULT
         )
+        self._prefetch_note = None
+        if self._device_prefetch == "auto":
+            # per-host staging depth from THIS host's free HBM headroom
+            # (parallel/planner.py): a straggler host with less headroom
+            # stages shallower locally instead of stalling the collective
+            # dispatch at a fleet-global constant.  One staged chunk is
+            # K stacked uint8 image batches + int labels.
+            from ..parallel import planner as planner_mod
+
+            size = getattr(hparams, "image_size", 32) or 32
+            local_batch = host_local_batch_slice(hparams.batch_size)
+            chunk_bytes = (
+                max(1, getattr(hparams, "host_chunk_steps",
+                               HOST_CHUNK_STEPS_DEFAULT))
+                * local_batch * (size * size * 3 + 8)
+            )
+            free = planner_mod.hbm_free_bytes()
+            self._device_prefetch = planner_mod.auto_staging_depth(
+                chunk_bytes, free, default=DEVICE_PREFETCH_DEFAULT
+            )
+            self._prefetch_note = (
+                f"--device-prefetch auto: staging depth "
+                f"{self._device_prefetch} on this host "
+                + (
+                    f"({free / 2**20:.0f} MB free HBM, "
+                    f"{chunk_bytes / 2**20:.1f} MB/chunk)"
+                    if free is not None
+                    else "(no device memory stats; default kept)"
+                )
+            )
+        self._device_prefetch = int(self._device_prefetch)
         if self.data_mode == "device":
             self.chunk_runner = None
         else:
@@ -631,6 +711,21 @@ class Trainer:
         self.logger = setup_logger(
             self.version_dir, is_main_process=self.is_main, to_stdout=True
         )
+        if self.plan is not None:
+            from ..parallel import planner as planner_mod
+
+            self.logger.info(
+                ("installed " if self._plan_installed else
+                 "dump only (hand flags kept) — ")
+                + planner_mod.format_plan(self.plan)
+            )
+        elif self._plan_refusal:
+            self.logger.warning(
+                "--parallel-plan dump: no feasible planned layout (hand "
+                f"flags kept): {self._plan_refusal}"
+            )
+        if self._prefetch_note:
+            self.logger.info(self._prefetch_note)
         self.version = (
             int(self.version_dir.name.split("-")[1]) if self.version_dir else -1
         )
@@ -683,11 +778,36 @@ class Trainer:
                     raise ValueError(
                         f"refusing to resume from {hparams.resume}: {reason}"
                     )
+            resume_info: dict = {}
             state, self.start_epoch, self.best_acc = ckpt.load_resume_state(
-                hparams.resume, self.state, raw_bytes=resume_bytes
+                hparams.resume, self.state, raw_bytes=resume_bytes,
+                info=resume_info,
             )
             resume_bytes = None  # drop the (possibly GB-sized) buffer now
-            state = self._reset_comms_residual(state)
+            res_note = resume_info.get("comms_residual", "absent")
+            if res_note == "restored" and not self._ckpt_residual:
+                # the documented cross-flag contract: a run that did not
+                # pass --ckpt-comms-residual gets flag-off behavior even
+                # when the checkpoint carries the residual — drop and
+                # warn, never silently restore off an absent flag
+                res_note = "dropped:ckpt-comms-residual off on this run"
+            if res_note == "restored":
+                # --ckpt-comms-residual round trip: the error-feedback
+                # carry continues instead of restarting at zero
+                self.logger.info(
+                    "comms: error-feedback residual restored from the "
+                    "checkpoint (--ckpt-comms-residual)"
+                )
+            else:
+                if res_note.startswith("dropped"):
+                    # the documented cross-flag path: saved with a
+                    # residual this run cannot carry — drop and warn
+                    self.logger.warning(
+                        "comms: checkpointed error-feedback residual "
+                        f"dropped ({res_note.split(':', 1)[1]}); "
+                        "restarting it at zero"
+                    )
+                state = self._reset_comms_residual(state)
             # from_state_dict returns host numpy leaves; re-place them as
             # global mesh arrays with the run's layout (jit on a multi-host
             # mesh requires global jax.Arrays, not host buffers).  The
@@ -981,22 +1101,26 @@ class Trainer:
             self.resources.sample(self.metrics)
             self.metrics.maybe_flush(self.bus, epoch=epoch, step=step)
 
-    @staticmethod
-    def _ckpt_view(state):
-        """The state as every checkpoint path consumes it: without the
-        comms error-feedback residual.  ``_state_dict`` never serializes
-        the residual, so fetching/snapshotting it would pay a
-        params-sized device→host gather (or HBM copy) per save for bytes
-        that are discarded."""
-        if state.comms_residual is None:
+    def _ckpt_view(self, state):
+        """The state as every checkpoint path consumes it.  By default
+        the comms error-feedback residual is dropped before the fetch —
+        ``_state_dict`` serializes it only when present, so carrying it
+        would pay a params-sized device→host gather (or HBM copy) per
+        save for bytes that are discarded.  ``--ckpt-comms-residual``
+        keeps it: the save then serializes the residual and the manifest
+        records its presence, so resume no longer restarts the
+        quantization error at zero."""
+        if state.comms_residual is None or self._ckpt_residual:
             return state
         return state.replace(comms_residual=None)
 
     def _reset_comms_residual(self, state):
-        """Restart the compressed-sync error-feedback residual at zero
-        (resume and rollback both land here: the residual is never
-        checkpointed, and a rolled-back residual belonged to the
-        discarded trajectory).  HOST zeros, deliberately — both callers
+        """Restart the compressed-sync error-feedback residual at zero.
+        Rollback ALWAYS lands here (a rolled-back residual belonged to
+        the discarded trajectory); resume lands here unless
+        ``--ckpt-comms-residual`` restored a matching checkpointed
+        residual (the only path that skips the reset — see the resume
+        branch above).  HOST zeros, deliberately — both callers
         feed ``place_tree``, whose multi-host branch cannot re-place a
         live partitioned device leaf.  The zeros' SHAPE follows the wire
         owner: params-shaped for the GSPMD comms path, the per-device
@@ -1035,6 +1159,10 @@ class Trainer:
         # records the delta for the log
         meta["shard_optim"] = self.shard_optim
         meta["grad_comms"] = self.grad_comms
+        # does this checkpoint carry the error-feedback residual?  A
+        # restore that cannot use it (flag off, fp32 wire, or a changed
+        # wire layout) reads this to say WHY it dropped it.
+        meta["comms_residual"] = self._ckpt_residual
         if self._pipe_meta is not None:
             # the pipeline layout the checkpoint was trained under:
             # restore across a schedule / pipe-degree change is a plain
@@ -1746,14 +1874,22 @@ class Trainer:
             )
             if not found:
                 return None
-            template = ckpt._state_dict(self.state)
+            # the comms error-feedback residual never rides the rollback
+            # broadcast: a rolled-back residual belonged to the discarded
+            # trajectory, so every process resets it below — and the live
+            # (possibly cross-host-sharded) leaf could not be np.asarray'd
+            # symmetrically anyway
+            def _no_residual(sd: dict) -> dict:
+                return {k: v for k, v in sd.items() if k != "comms_residual"}
+
+            template = _no_residual(ckpt._state_dict(self.state))
             if self.is_main:
                 path, data = hit
                 state0, next_epoch, best = ckpt.load_resume_state(
                     path, self.state, raw_bytes=data
                 )
                 host = jax.tree_util.tree_map(
-                    np.asarray, ckpt._state_dict(state0)
+                    np.asarray, _no_residual(ckpt._state_dict(state0))
                 )
                 meta = np.asarray([next_epoch, best], np.float64)
             else:

@@ -15,6 +15,10 @@ Usage::
                                                       # action still pending
     python tools/run_report.py CKPT_ROOT --compute    # per-executable
                                                       # cost/memory/MFU table
+    python tools/run_report.py CKPT_ROOT --plan       # auto-parallel plan
+                                                      # prediction vs measured;
+                                                      # rc=1 when an installed
+                                                      # plan was ignored
     python tools/run_report.py CKPT_ROOT --export-openmetrics [OUT]
                                                       # offline scrape render
     python tools/run_report.py CKPT_ROOT --xplane OUT.json \\
@@ -891,6 +895,165 @@ def policy_report(path: str | Path, out=print) -> int:
     return 0
 
 
+def _plan_layout_of_run_start(p: dict) -> dict:
+    """The layout a ``run_start`` payload actually ran — the comparison
+    frame of a ``plan`` event's ``layout`` dict."""
+    mesh = p.get("mesh") or {}
+    return {
+        "data": int(mesh.get("data", 1) or 1),
+        "model": int(mesh.get("model", 1) or 1),
+        "pipe": int(mesh.get("pipe", 1) or 1),
+        "shard_optim": bool(p.get("shard_optim", False)),
+        "grad_comms": str(p.get("grad_comms", "fp32") or "fp32"),
+    }
+
+
+def plan_report(path: str | Path, out=print) -> int:
+    """The ``--plan`` view: every auto-parallel planning decision under
+    ``path`` — the chosen layout, every candidate's predicted step-s/HBM
+    (prediction vs MEASURED for the layout that actually ran, so a
+    mis-prediction is inspectable), and the cost-model fit provenance.
+
+    Exit 0 when every *installed* plan's chosen layout agrees with the
+    attempt's ``run_start`` layout; 1 on any disagreement — a plan the
+    run silently ignored must fail the stream check — and 2 when
+    ``path`` holds no events at all.  ``dump``-mode plans (``installed``
+    false) are rendered but never gate: ignoring them is their contract.
+    """
+    events, _files = load_run(path)
+    if not events:
+        out(f"{path}: no events found")
+        return 2
+    plans = [ev for ev in events if ev.get("kind") == "plan"]
+    if not plans:
+        out(f"{path}: no plan events (no --parallel-plan, or the planner "
+            "never ran)")
+        return 0
+    run_starts = [
+        ev for ev in events
+        if ev.get("kind") == "run_start"
+        and int(ev.get("process_index", 0) or 0) == 0
+    ]
+    # measured seconds-per-step keyed by (run_id, attempt): epoch_end's
+    # images_per_sec against that attempt's global batch (median across
+    # epochs).  run_id matters — two independent runs sharing a ckpt root
+    # (the bench capture + plan legs) both count attempt 0, and blending
+    # their epochs would misreport the planned layout's measured seconds.
+    def _run_key(ev) -> tuple:
+        return (ev.get("run_id"), int(ev.get("attempt", 0) or 0))
+
+    batch_by_attempt = {
+        _run_key(ev): int(_payload(ev).get("batch_size", 0) or 0)
+        for ev in run_starts
+    }
+    step_s_by_attempt: dict[tuple, list] = {}
+    for ev in events:
+        if ev.get("kind") != "epoch_end" or int(
+            ev.get("process_index", 0) or 0
+        ):
+            continue
+        ips = _payload(ev).get("images_per_sec")
+        batch = batch_by_attempt.get(_run_key(ev))
+        if ips and batch:
+            step_s_by_attempt.setdefault(_run_key(ev), []).append(
+                batch / float(ips)
+            )
+    rc = 0
+    t0 = events[0].get("t_wall", 0.0)
+    for ev in plans:
+        p = _payload(ev)
+        attempt = int(p.get("attempt", ev.get("attempt", 0)) or 0)
+        chosen = p.get("chosen") or {}
+        fit = p.get("fit") or {}
+        out(
+            f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s] PLAN attempt {attempt} "
+            f"({p.get('reason', '?')}, {'installed' if p.get('installed') else 'dump only'}): "
+            f"{chosen.get('key', '?')} on {p.get('devices', '?')} device(s), "
+            f"model {p.get('model', '?')}, batch {p.get('batch_size', '?')} "
+            f"[fit: {fit.get('source', '?')}"
+            + (f", {fit.get('n_points')} pt(s)" if fit.get("n_points") else "")
+            + "]"
+        )
+        measured = step_s_by_attempt.get((ev.get("run_id"), attempt))
+        measured_s = sorted(measured)[len(measured) // 2] if measured else None
+        header = (
+            f"    {'candidate':<22} {'pred step_s':>12} {'pred HBM(MB)':>13} "
+            f"{'measured':>10}"
+        )
+        out(header)
+        for c in p.get("candidates") or []:
+            is_chosen = c.get("key") == chosen.get("key")
+            hbm = c.get("predicted_hbm_bytes")
+            meas = (
+                f"{measured_s:10.6f}" if (is_chosen and measured_s) else
+                f"{'-':>10}"
+            )
+            out(
+                f"    {c.get('key', '?'):<22} "
+                f"{c.get('predicted_step_s') or 0:>12.6f} "
+                f"{(hbm / 2**20 if hbm else 0):>13.1f} {meas}"
+                + ("  <- chosen" if is_chosen else "")
+            )
+        if p.get("candidates_elided"):
+            out(f"    (+{p['candidates_elided']} candidate(s) elided, "
+                f"{p.get('refused', 0)} shape(s) refused)")
+        if measured_s and chosen.get("predicted_step_s"):
+            ratio = measured_s / float(chosen["predicted_step_s"])
+            out(
+                f"    chosen predicted {chosen['predicted_step_s']:.6f}s "
+                f"vs measured {measured_s:.6f}s per step "
+                f"(measured/predicted {ratio:.2f}x)"
+            )
+        if not p.get("installed"):
+            continue
+        # the gate: an INSTALLED plan must be the layout run_start ran
+        following = [
+            rs for rs in run_starts
+            if int(rs.get("attempt", 0) or 0) == attempt
+            and rs.get("run_id") == ev.get("run_id")
+            and rs.get("t_wall", 0.0) >= ev.get("t_wall", 0.0) - 1.0
+        ]
+        if not following:
+            out(f"    (no run_start for attempt {attempt} follows this "
+                "plan — run died before construction?)")
+            continue
+        got = _plan_layout_of_run_start(_payload(following[0]))
+        want = dict(p.get("layout") or {})
+        # supervisor-side plans size the data axis for the whole fleet;
+        # the pid-level CPU emulation's rank 0 joins a smaller world than
+        # planned (it skips the collectives the pinned jax cannot run on
+        # CPU), so the data-axis check scales by the world share — on a
+        # real pod the worlds agree and the comparison stays exact
+        plan_world = int(p.get("world", 0) or 0)
+        got_world = int(_payload(following[0]).get("world_size", 1) or 1)
+        if (
+            plan_world
+            and got_world != plan_world
+            and "data" in want
+            and (int(want["data"]) * got_world) % plan_world == 0
+        ):
+            want["data"] = int(want["data"]) * got_world // plan_world
+        diffs = {
+            k: (want.get(k), got.get(k))
+            for k in got
+            if k in want and want.get(k) != got.get(k)
+        }
+        if diffs:
+            rc = 1
+            out(
+                "    PLAN MISMATCH: run_start ran a different layout — "
+                + ", ".join(
+                    f"{k}: planned {a!r} ran {b!r}"
+                    for k, (a, b) in sorted(diffs.items())
+                )
+            )
+    if rc:
+        out("an installed plan was silently ignored (layout mismatch)")
+    else:
+        out("every installed plan matches its attempt's run_start layout")
+    return rc
+
+
 def export_openmetrics(path: str | Path, out_path: str | None = None) -> str:
     """The scrape-less exposition: fold a finished (or in-flight) run's
     ``metrics`` events — plus the serve records' latency deltas — into
@@ -1338,6 +1501,15 @@ def main(argv: list[str]) -> int:
         "pending — the chaos-gauntlet gate",
     )
     ap.add_argument(
+        "--plan", action="store_true",
+        help="print the auto-parallel planning decisions (parallel/"
+        "planner.py): chosen layout, every candidate's predicted "
+        "step-s/HBM vs the measured seconds of the layout that ran, fit "
+        "provenance; exit 1 when an INSTALLED plan's chosen layout "
+        "disagrees with the attempt's run_start layout — a silently "
+        "ignored plan must fail the stream check",
+    )
+    ap.add_argument(
         "--export-openmetrics", metavar="OUT", default=None, nargs="?",
         const="-",
         help="render the run's merged metrics/heartbeats/alerts in the "
@@ -1378,6 +1550,12 @@ def main(argv: list[str]) -> int:
         rc = 0
         for path in args.paths:
             rc = max(rc, policy_report(path))
+        return rc
+
+    if args.plan:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, plan_report(path))
         return rc
 
     if args.export_openmetrics is not None:
