@@ -581,64 +581,122 @@ def emit_progress(key: str, result: dict) -> None:
 
 
 def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
-    """The serving leg: engine + micro-batcher under closed- and open-loop
-    load, one committed JSON capture (``BENCH_SERVE.json``) the README's
-    latency/throughput table transcribes.
+    """The serving leg, v2: the production fast path's scoreboard.
+
+    Four legs, one committed JSON capture (``BENCH_SERVE.json``) the
+    README's tables transcribe:
+
+    1. **continuous vs bucketed** — the same warmed engine behind the
+       two admission policies under the PARTIAL-LOAD shape (a LIGHT
+       closed loop at concurrency 1 — the worker is idle as each
+       request arrives, so the bucketed window's cost is structural,
+       not scheduling noise — plus an open-loop Poisson leg at ~60% of
+       measured capacity): the bucketed window vs step-boundary
+       admission.  Headline: continuous throughput ÷ bucketed at
+       matched-or-better p99.
+    2. **cold start** — two REAL fresh processes against one persisted
+       AOT store (``--serve-cold-child``): the first compiles and
+       stores, the second deserializes by fingerprint.  The capture
+       asserts the restarted replica's stream carries ZERO compile
+       events that aren't ``cache: "persisted"`` and records the
+       measured compile-seconds drop.
+    3. **router scale-out** — 1 vs 2 replicas behind the shared
+       SLO-class queue at closed-loop saturation (informational on CPU:
+       replicas share the cores, so parity is expected and noted; the
+       leg pins the routing machinery's overhead, not the speedup).
+    4. **SLO classes** — mixed tenancy (gold with deadline+target,
+       bulk) through the router; ``run_report --serve``'s per-class
+       attainment gate runs as the leg's self-check.
 
     Weights are fresh-initialized (latency/throughput do not depend on
-    their values); the load shapes are the two canonical ones — a
-    closed-loop saturation run (peak batched throughput) and open-loop
-    Poisson runs at increasing offered rates (tail latency vs load, the
-    curve the queue-limit/deadline machinery exists for).  Sized down on
-    CPU so the capture is reproducible on the CI host.
+    their values).  Sized down on CPU so the capture is reproducible on
+    the CI host.
     """
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
     from distributed_training_comparison_tpu.serve import (
         MicroBatcher,
         ServeEngine,
+        ServeRouter,
         closed_loop,
+        mixed_tenants,
         open_loop,
+        parse_slo_classes,
         request_pool,
     )
-    from distributed_training_comparison_tpu.utils import (
-        enable_persistent_compilation_cache,
-    )
+    from distributed_training_comparison_tpu.utils import PersistedServeCache
 
-    enable_persistent_compilation_cache()
     platform = jax.devices()[0].platform
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # closed_conc=1 for the headline legs ON PURPOSE: the bucketed
+    # window's cost is structural only when the worker is IDLE as a
+    # request arrives (it then holds the lone request the full window
+    # hoping a bucket fills) — at higher concurrency the window hides
+    # under the previous dispatch's compute and the comparison decays
+    # into run-to-run noise.  Concurrency-N behavior (slot-fill
+    # coalescing) is pinned by the open-loop and router legs.
     if platform == "cpu":  # CI smoke sizing (this container: few cpu cores)
         model_name, image_size = "resnet18", 32
         buckets = (1, 4, 8, 16)
-        closed_requests, closed_conc = 96, 8
-        open_rates, open_requests = (64.0, 256.0), 96
-        max_wait_ms, queue_limit = 2.0, 128
+        closed_requests, closed_conc = 64, 1
+        open_requests = 96
+        router_requests, router_conc = 96, 8
+        bucketed_wait_ms = 25.0
     else:
         model_name, image_size = "resnet18", 32
         buckets = (1, 4, 16, 64, 256)
-        closed_requests, closed_conc = 8192, 64
-        open_rates, open_requests = (1000.0, 4000.0, 16000.0), 4096
-        max_wait_ms, queue_limit = 2.0, 4096
+        closed_requests, closed_conc = 1024, 1
+        open_requests = 2048
+        router_requests, router_conc = 8192, 64
+        bucketed_wait_ms = 5.0
 
     # the capture's own event stream: bucket compiles land as `compile`
-    # events (cost/memory analysis + cache outcome) and the per-bucket
-    # dispatch sketches flush at the end — the committed record then
-    # self-validates with run_report --check --require-kind compile, so a
-    # silently-degraded compile hook can't produce a trusted capture
-    import tempfile
-
+    # events, the router emits `serve_route`/`replica`, and the committed
+    # record self-validates with run_report --check --require-kind
+    # compile --require-kind serve_route — a silently-degraded hook
+    # can't produce a trusted capture
     from distributed_training_comparison_tpu import obs
 
     serve_events_root = tempfile.mkdtemp(prefix="serve-bench-")
+    aot_dir = os.path.join(serve_events_root, "serve-aot")
+    # a PRIVATE, EMPTY jax HLO cache for this capture (not the ambient
+    # shared one): the warmup must pay REAL compiles — an executable
+    # materialized from a warm HLO cache serializes into an AOT blob
+    # whose fusion symbols are missing on this jaxlib (the store-time
+    # round-trip verify refuses it), so an ambient-cache-warm machine
+    # would otherwise commit a scoreboard with zero persisted
+    # warm-starts.  A fresh dir also makes warmup_compile_s reproducible
+    # wherever the capture runs.
+    main_jax_cache = os.path.join(serve_events_root, "jax-cache-main")
+    os.makedirs(main_jax_cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", main_jax_cache)
     bus = obs.configure(run_id=obs.new_run_id())
     bus.bind_dir(serve_events_root)
     registry = obs.MetricRegistry()
     monitor = obs.CompileMonitor(bus=bus, registry=registry)
+    aot_cache = PersistedServeCache(aot_dir)
 
+    legs: dict = {}
+
+    def leg(key, fn):
+        try:
+            legs[key] = fn()
+        except Exception as e:  # evidence over abort, like run_legs
+            legs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        emit_progress(key, legs[key])
+        return legs[key]
+
+    # ---- leg 1: continuous vs bucketed on ONE warmed engine ----------
     engine = ServeEngine(
         model_name=model_name,
         buckets=buckets,
         precision="bf16",
         image_size=image_size,
         monitor=monitor,
+        aot_cache=aot_cache,
     )
     t0 = time.perf_counter()
     engine.warmup()
@@ -646,85 +704,353 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
     images = request_pool(
         max(256, engine.max_bucket), image_size=image_size, seed=0
     )
-    legs: dict = {}
 
-    def leg(key, fn):
-        try:
+    def engine_delta(before, after):
+        """Per-LEG engine counters (the shared engine accumulates across
+        legs; a leg's record must carry only its own traffic) — a
+        mid-leg recompile poisoning one side of the continuous-vs-
+        bucketed comparison must be diagnosable from the committed
+        record."""
+        return {
+            "compiles": after["compiles"] - before["compiles"],
+            "cache_hits": after["cache_hits"] - before["cache_hits"],
+            "persisted_hits": (
+                after["persisted_hits"] - before["persisted_hits"]
+            ),
+            "bucket_counts": {
+                b: after["bucket_counts"][b] - before["bucket_counts"][b]
+                for b in after["bucket_counts"]
+            },
+        }
+
+    def closed_leg(mode):
+        def run():
             before = engine.stats()
             with MicroBatcher(
-                engine, max_wait_ms=max_wait_ms, queue_limit=queue_limit
-            ) as batcher:
-                legs[key] = fn(batcher)
-            after = engine.stats()
-            # per-LEG engine counters (the shared engine accumulates
-            # across legs; a leg's record must carry only its own traffic)
-            legs[key]["engine"] = {
-                "buckets": after["buckets"],
-                "compiles": after["compiles"] - before["compiles"],
-                "cache_hits": after["cache_hits"] - before["cache_hits"],
-                "bucket_counts": {
-                    b: after["bucket_counts"][b] - before["bucket_counts"][b]
-                    for b in after["bucket_counts"]
-                },
-            }
-        except Exception as e:  # evidence over abort, like run_legs
-            legs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
-        emit_progress(key, legs[key])
+                engine, max_wait_ms=bucketed_wait_ms, queue_limit=1024,
+                mode=mode,
+            ) as b:
+                rep = closed_loop(
+                    b, images, num_requests=closed_requests,
+                    concurrency=closed_conc,
+                )
+            rep["mode_admission"] = mode
+            rep["engine"] = engine_delta(before, engine.stats())
+            return rep
+        return run
 
-    leg(
-        f"closed_c{closed_conc}",
-        lambda b: closed_loop(
-            b, images, num_requests=closed_requests, concurrency=closed_conc
-        ),
-    )
-    for rate in open_rates:
-        leg(
-            f"open_r{int(rate)}",
-            lambda b, r=rate: open_loop(
-                b, images, rate_rps=r, num_requests=open_requests, seed=0
+    bucketed = leg("partial_closed_bucketed", closed_leg("bucketed"))
+    continuous = leg("partial_closed_continuous", closed_leg("continuous"))
+
+    # the open-loop partial shape at ~60% of measured continuous capacity
+    open_rate = None
+    if "error" not in continuous:
+        open_rate = max(1.0, 0.6 * continuous["throughput_rps"])
+
+        def open_leg(mode):
+            def run():
+                before = engine.stats()
+                with MicroBatcher(
+                    engine, max_wait_ms=bucketed_wait_ms, queue_limit=1024,
+                    mode=mode,
+                ) as b:
+                    rep = open_loop(
+                        b, images, rate_rps=open_rate,
+                        num_requests=open_requests, seed=0,
+                    )
+                rep["mode_admission"] = mode
+                rep["engine"] = engine_delta(before, engine.stats())
+                return rep
+            return run
+
+        leg("partial_open_bucketed", open_leg("bucketed"))
+        leg("partial_open_continuous", open_leg("continuous"))
+
+    headline = None
+    if "error" not in bucketed and "error" not in continuous:
+        headline = {
+            "continuous_over_bucketed_rps": round(
+                continuous["throughput_rps"]
+                / max(1e-9, bucketed["throughput_rps"]), 3
             ),
+            "p99_ms_bucketed": bucketed["latency_ms"]["p99"],
+            "p99_ms_continuous": continuous["latency_ms"]["p99"],
+            "p99_matched": bool(
+                continuous["latency_ms"]["p99"]
+                <= bucketed["latency_ms"]["p99"]
+            ),
+        }
+
+    # ---- leg 2: persisted-AOT cold start (two REAL fresh processes) --
+    def cold_start_leg():
+        # a PRIVATE jax HLO cache shared by both children isolates the
+        # comparison: child 1 pays real compiles (cold everything) and
+        # stores the AOT blobs; child 2 deserializes by fingerprint.
+        # The leg gets its OWN empty AOT store — the session-wide
+        # `aot_dir` was already populated by leg 1's warmup, and a
+        # pre-warmed store would hand the "cold" child a millisecond
+        # load, deleting the very compile-seconds drop being measured.
+        jax_cache = os.path.join(serve_events_root, "jax-cache")
+        leg_aot_dir = os.path.join(serve_events_root, "serve-aot-coldleg")
+        out = {}
+        for tag in ("cold", "warm"):
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS=platform,
+                JAX_COMPILATION_CACHE_DIR=jax_cache,
+            )
+            child_dir = os.path.join(serve_events_root, f"version-{tag}")
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [
+                    sys.executable, os.path.join(repo, "bench.py"),
+                    "--serve-cold-child", child_dir, leg_aot_dir,
+                ],
+                cwd=repo, env=env, capture_output=True, text=True,
+                timeout=600,
+            )
+            wall = time.perf_counter() - t0
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"cold-start child ({tag}) rc={proc.returncode}: "
+                    f"{(proc.stderr or '')[-800:]}"
+                )
+            child = json.loads(proc.stdout.strip().splitlines()[-1])
+            child["process_wall_s"] = round(wall, 2)
+            # judge the stream, not the child's self-report: compile
+            # events in this child's version dir
+            caches = []
+            from distributed_training_comparison_tpu.obs import load_events
+
+            for ev in load_events(
+                os.path.join(child_dir, "events.jsonl")
+            ):
+                if ev.get("kind") == "compile":
+                    caches.append((ev.get("payload") or {}).get("cache"))
+            child["stream_compile_caches"] = caches
+            out[tag] = child
+        real_compiles_in_warm = sum(
+            1 for c in out["warm"]["stream_compile_caches"]
+            if c != "persisted"
         )
+        real_compiles_in_cold = sum(
+            1 for c in out["cold"]["stream_compile_caches"]
+            if c != "persisted"
+        )
+        out["summary"] = {
+            "cold_warmup_s": out["cold"]["warmup_s"],
+            "warm_warmup_s": out["warm"]["warmup_s"],
+            "warmup_speedup": round(
+                out["cold"]["warmup_s"] / max(1e-9, out["warm"]["warmup_s"]),
+                2,
+            ),
+            "compile_s_cold": out["cold"]["compile_s"],
+            "load_s_warm": out["warm"]["compile_s"],
+            "compile_s_drop": round(
+                out["cold"]["compile_s"] - out["warm"]["compile_s"], 3
+            ),
+            # the acceptance bar: the restarted replica compiled NOTHING
+            "real_compile_events_in_warm_stream": real_compiles_in_warm,
+            "persisted_hits_warm": out["warm"]["persisted_hits"],
+        }
+        if real_compiles_in_warm:
+            raise RuntimeError(
+                f"persisted-AOT cold start leaked {real_compiles_in_warm} "
+                "real compile(s) in the restarted replica's stream"
+            )
+        if not real_compiles_in_cold:
+            # a "cold" child that compiled nothing measured nothing: the
+            # leg's AOT store leaked pre-warmed blobs (the bug this guard
+            # pins) and the drop above would be vacuously zero
+            raise RuntimeError(
+                "cold-start child paid no real compile — its AOT store "
+                "was not empty, so the leg measured no drop"
+            )
+        return out
+
+    leg("cold_start", cold_start_leg)
+
+    # ---- legs 3+4: router scale-out + SLO classes --------------------
+    def router_leg(n_replicas):
+        def run():
+            # arm_sentinel=False + monitor= on the router: replica
+            # warmup compiles (e.g. a store-verify-rejected AOT blob)
+            # must not land as recompile-storm flags in the committed
+            # ledger — same arming design serve_main uses
+            r = ServeRouter(
+                lambda rid: ServeEngine(
+                    model_name=model_name, buckets=buckets,
+                    precision="bf16", image_size=image_size,
+                    monitor=monitor, aot_cache=aot_cache,
+                    arm_sentinel=False,
+                ),
+                replicas=n_replicas, bus=bus, registry=registry,
+                emit_every_s=2.0, queue_limit=1024, monitor=monitor,
+            )
+            try:
+                r.warmup()
+                rep = closed_loop(
+                    r, images, num_requests=router_requests,
+                    concurrency=router_conc,
+                )
+            finally:
+                r.close()
+            rep["router"] = r.stats()
+            return rep
+        return run
+
+    r1 = leg("router_1_replica", router_leg(1))
+    r2 = leg("router_2_replicas", router_leg(2))
+    router_summary = None
+    if "error" not in r1 and "error" not in r2:
+        router_summary = {
+            "scale_out_rps_ratio": round(
+                r2["throughput_rps"] / max(1e-9, r1["throughput_rps"]), 3
+            ),
+            "replica_warm_starts_from_persisted": r2["router"]["engine"][
+                "persisted_hits"
+            ],
+        }
+
+    def slo_leg():
+        classes = parse_slo_classes(
+            "gold:priority=0:deadline_ms=10000:target=0.9,"
+            "bulk:priority=2"
+        )
+        r = ServeRouter(
+            lambda rid: ServeEngine(
+                model_name=model_name, buckets=buckets,
+                precision="bf16", image_size=image_size,
+                monitor=monitor, aot_cache=aot_cache,
+                arm_sentinel=False,
+            ),
+            replicas=1, classes=classes, bus=bus, registry=registry,
+            emit_every_s=1.0, queue_limit=1024, monitor=monitor,
+        )
+        try:
+            r.warmup()
+            rep = mixed_tenants(
+                r, images,
+                tenants={
+                    "gold": {"rate_rps": 16.0,
+                             "num_requests": open_requests // 2},
+                    "bulk": {"rate_rps": 16.0,
+                             "num_requests": open_requests // 2},
+                },
+                seed=0,
+            )
+        finally:
+            r.close()
+        rep["classes"] = r.metrics.class_payload()
+        return rep
+
+    leg("slo_mixed_tenants", slo_leg)
 
     registry.flush(bus)  # per-bucket exec/... dispatch sketches → stream
     obs.reset(bus)
+
+    # the leg's self-checks: schema + required kinds, and the per-class
+    # SLO attainment gate reconstructed from the stream alone
+    check_rc = events_check_rc(
+        serve_events_root, require_kinds=("compile", "serve_route")
+    )
+    serve_gate_rc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "run_report.py"),
+         serve_events_root, "--serve"],
+    ).returncode
+
     record = {
         "metric": "cifar100_resnet18_serve",
+        "version": 2,
         "platform": platform,
         "device_kind": jax.devices()[0].device_kind,
         "model": model_name,
         "precision": "bf16",
         "buckets": list(buckets),
-        "max_wait_ms": max_wait_ms,
-        "queue_limit": queue_limit,
+        "bucketed_wait_ms": bucketed_wait_ms,
+        "closed_concurrency": closed_conc,
+        "open_rate_rps": round(open_rate, 2) if open_rate else None,
         "warmup_compile_s": round(warmup_s, 2),
+        "continuous_vs_bucketed": headline,
+        "router_scale_out": router_summary,
         "compile_ledger": monitor.ledger(),
-        "events_check_rc": events_check_rc(
-            serve_events_root, require_kinds=("compile",)
-        ),
+        "events_check_rc": check_rc,
+        "run_report_serve_rc": serve_gate_rc,
         "legs": legs,
+        "note": (
+            "CPU capture: one shared core set — the router scale-out "
+            "leg is informational (replicas contend for the same "
+            "silicon, parity expected; the leg pins routing overhead), "
+            "and absolute latencies are CPU service times.  The "
+            "continuous-vs-bucketed ordering and the cold-start "
+            "compile-seconds drop bind; the bucketed baseline's window "
+            f"is {bucketed_wait_ms} ms (tuned long enough to actually "
+            "fill buckets at partial load — the tail cliff being "
+            "measured)."
+        ),
     }
     with open(out_path, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps({
         "metric": record["metric"],
         "platform": platform,
-        "events_check_rc": record["events_check_rc"],
-        "legs": {
-            k: (
-                {
-                    "rps": v.get("throughput_rps"),
-                    "p50_ms": v.get("latency_ms", {}).get("p50"),
-                    "p99_ms": v.get("latency_ms", {}).get("p99"),
-                    "shed": v.get("shed"),
-                }
-                if "error" not in v
-                else "err"
-            )
-            for k, v in legs.items()
-        },
+        "events_check_rc": check_rc,
+        "run_report_serve_rc": serve_gate_rc,
+        "continuous_vs_bucketed": headline,
+        "cold_start": (legs.get("cold_start") or {}).get("summary"),
+        "router_scale_out": router_summary,
         "full_record": out_path,
     }))
     return record
+
+
+def _bench_serve_cold_child(argv) -> None:
+    """One REAL fresh serving process for the cold-start leg: build the
+    engine against the given persisted AOT store, warm the ladder, serve
+    a smoke batch, print one JSON line.  ``argv = [events_dir,
+    aot_cache_dir]``.  Every compile/load lands as a ``compile`` event
+    in ``events_dir`` — the parent judges the STREAM, not this report."""
+    import os
+
+    from distributed_training_comparison_tpu import obs
+    from distributed_training_comparison_tpu.serve import ServeEngine
+    from distributed_training_comparison_tpu.utils import PersistedServeCache
+
+    events_dir, aot_dir = argv[0], argv[1]
+    t_start = time.perf_counter()
+    bus = obs.configure(run_id=obs.new_run_id())
+    bus.bind_dir(events_dir)
+    registry = obs.MetricRegistry()
+    monitor = obs.CompileMonitor(bus=bus, registry=registry)
+    engine = ServeEngine(
+        model_name="resnet18",
+        buckets=(1, 8),
+        precision="bf16",
+        image_size=32,
+        monitor=monitor,
+        aot_cache=PersistedServeCache(aot_dir),
+    )
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    # first response: the reason cold start matters
+    t0 = time.perf_counter()
+    engine.predict_logits(np.zeros((3, 32, 32, 3), np.uint8))
+    first_response_s = time.perf_counter() - t0
+    registry.flush(bus)
+    ledger = monitor.ledger()
+    print(json.dumps({
+        "warmup_s": round(warmup_s, 3),
+        "first_response_s": round(first_response_s, 3),
+        "init_to_first_response_s": round(
+            time.perf_counter() - t_start, 3
+        ),
+        "compiles": engine.stats()["compiles"],
+        "persisted_hits": engine.stats()["persisted_hits"],
+        "compile_s": round(sum(r["compile_s"] for r in ledger), 3),
+        "caches": [r["cache"] for r in ledger],
+    }))
+    obs.reset(bus)
 
 
 def events_check_rc(ckpt_root: str, require_kinds=()) -> int:
@@ -945,6 +1271,128 @@ def bench_resilience(out_path: str = "GOODPUT.json") -> dict:
     return record
 
 
+def _run_serve_chaos_scenario(name: str, sc: dict, repo: str, run_report):
+    """One ``session: "serve"`` chaos scenario: run the real ``--serve``
+    entry (flash crowd onto an unwarmed bucket), judge the storm →
+    sentinel alert → ``rewarm_serve`` → p99-recovery chain from the
+    event stream alone.  Returns ``(row, problems, events_check_rc)``
+    shaped like the fleet scenarios' rows."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from distributed_training_comparison_tpu.ops.policy import pending_actions
+    from distributed_training_comparison_tpu.resilience import (
+        check_chaos_expectations,
+    )
+
+    root = tempfile.mkdtemp(prefix=f"chaos-{name}-")
+    cmd = [
+        sys.executable, os.path.join(repo, "src", "tpu_jax", "main.py"),
+        *sc["extra_args"],
+        "--ckpt-path", root, "--seed", "7", "--no-progress",
+        "--policy-mode", sc["policy_mode"],
+    ]
+    for spec in sc["alerts"]:
+        cmd += ["--alert", spec]
+    for spec in sc["policies"]:
+        cmd += ["--policy", spec]
+    env = dict(os.environ)
+    env.update(sc["env"])
+    env.setdefault("JAX_PLATFORMS", jax.devices()[0].platform)
+    timed_out = False
+    proc = subprocess.Popen(
+        cmd, cwd=repo, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=900)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()
+        out, err = proc.communicate()
+
+    events, _files = run_report.load_run(root)
+    policy_states: dict[str, int] = {}
+    recompiles = 0
+    phases = None
+    for ev in events:
+        kind = ev.get("kind")
+        p = ev.get("payload") or {}
+        if kind == "policy":
+            st = p.get("state", "?")
+            policy_states[st] = policy_states.get(st, 0) + 1
+        elif kind == "compile" and p.get("recompile_after_warmup"):
+            recompiles += 1
+        elif kind == "serve" and p.get("phases"):
+            phases = p["phases"]
+    # recovery is judged against the WORST phase (the storm may land a
+    # burst early under Poisson arrivals): the final phase's p99 must sit
+    # below the cliff, wherever the cliff was — and the after phase must
+    # have actually COMPLETED requests (an empty phase's p99 is 0.0,
+    # which would read a total post-flash outage as "recovered")
+    p99_recovered = False
+    if phases and all(k in phases for k in ("before", "flash", "after")):
+        after = phases["after"]["latency_ms"]["p99"]
+        worst = max(
+            phases[k]["latency_ms"]["p99"] for k in ("before", "flash")
+        )
+        p99_recovered = bool(
+            phases["after"].get("n", 0) > 0
+            and after > 0
+            and after < worst
+        )
+    observed = {
+        "final_rc": proc.returncode,
+        "resizes": 0,
+        "rollbacks": 0,
+        "alerts_fired": sum(
+            1 for ev in events
+            if ev.get("kind") == "alert"
+            and (ev.get("payload") or {}).get("state") == "firing"
+        ),
+        "restarts": 0, "preemptions": 0,
+        "policy_requested": policy_states.get("requested", 0),
+        "policy_completed": policy_states.get("completed", 0),
+        "policy_failed": policy_states.get("failed", 0),
+        "policy_dry_run": policy_states.get("dry_run", 0),
+        "policy_cooldown": policy_states.get("cooldown", 0),
+        "policy_budget": policy_states.get("budget", 0),
+        "policy_pending": len(pending_actions(events)),
+        "crash_dump_evidence": False,
+        "goodput_frac": None,
+        "recompiles": recompiles,
+        "p99_recovered": p99_recovered,
+        "phases": phases,
+    }
+    problems = check_chaos_expectations(sc["expect"], observed)
+    if timed_out:
+        problems.append("scenario timed out after 900s (process killed)")
+    if observed["policy_pending"]:
+        problems.append(
+            f"{observed['policy_pending']} policy action(s) still "
+            "pending (requested, never completed)"
+        )
+    check_rc = events_check_rc(root, require_kinds=tuple(sc["require_kinds"]))
+    if check_rc != 0:
+        problems.append(f"events_check_rc={check_rc}")
+    row = {
+        "desc": sc["desc"],
+        "fault_plan": sc["fault_plan"],
+        "alerts": list(sc["alerts"]),
+        "policies": list(sc["policies"]),
+        "policy_mode": sc["policy_mode"],
+        "driver": [],
+        **observed,
+        "events_check_rc": check_rc,
+        "green": not problems,
+        "problems": problems,
+        "stderr_tail": (err or "")[-400:] if problems else "",
+    }
+    return row, problems, check_rc
+
+
 def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
     """The chaos gauntlet (ISSUE 13): run every named scenario of
     ``resilience.faults.CHAOS_SCENARIOS`` — preempt x straggler-stall x
@@ -1000,6 +1448,26 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
 
     for name in names:
         sc = CHAOS_SCENARIOS[name]
+        if sc.get("session") == "serve":
+            # the flash-crowd x serve axis: the real --serve entry, not
+            # the training fleet worker (see _run_serve_chaos_scenario)
+            row, problems, check_rc = _run_serve_chaos_scenario(
+                name, sc, repo, run_report
+            )
+            worst_rc = max(worst_rc, check_rc)
+            rows[name] = row
+            emit_progress(f"chaos/{name}", {
+                "rc": row["final_rc"], "green": row["green"],
+                "problems": problems,
+                "recompiles": row["recompiles"],
+                "p99_recovered": row["p99_recovered"],
+            })
+            if problems:
+                failures.append(
+                    f"{name}: {problems} (stderr tail: "
+                    f"{row.get('stderr_tail', '')})"
+                )
+            continue
         root = tempfile.mkdtemp(prefix=f"chaos-{name}-")
         goodput_json = os.path.join(root, "goodput-scenario.json")
         cmd = [
@@ -2778,6 +3246,10 @@ if __name__ == "__main__":
 
     if "--smoke" in sys.argv:
         smoke()
+    elif "--serve-cold-child" in sys.argv:
+        _bench_serve_cold_child(
+            sys.argv[sys.argv.index("--serve-cold-child") + 1:]
+        )
     elif "--serve" in sys.argv:
         bench_serve()
     elif "--resilience" in sys.argv:

@@ -497,9 +497,79 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "--max-wait-ms",
         type=float,
         default=2.0,
-        help="Micro-batcher coalescing window: a batch is dispatched when "
+        help="Bucketed-mode coalescing window: a batch is dispatched when "
         "it reaches the largest bucket or the oldest queued request has "
-        "waited this long",
+        "waited this long (continuous mode ignores it — the previous "
+        "dispatch IS the window)",
+    )
+    parser.add_argument(
+        "--serve-mode",
+        type=str,
+        default="continuous",
+        choices=("continuous", "bucketed"),
+        help="Batch admission policy: 'continuous' (production fast path "
+        "— queued requests are admitted into the next dispatch at every "
+        "step boundary, slot-filling the bucket ladder; kills the "
+        "flush-timeout tail cliff under partial load) or 'bucketed' (the "
+        "classic max-wait window, kept as the comparable baseline)",
+    )
+    parser.add_argument(
+        "--serve-replicas",
+        type=int,
+        default=1,
+        help="Engine replicas behind the router (serve/router.py): each "
+        "owns its own AOT bucket programs and pulls from one shared "
+        "SLO-class queue.  0 = size the fleet with the planner's "
+        "ledger-fit cost model (parallel/planner.py) from the committed "
+        "compile ledger under --ckpt-path and the offered --serve-rate",
+    )
+    parser.add_argument(
+        "--serve-classes",
+        type=str,
+        default="",
+        help="Per-tenant SLO classes: comma-separated "
+        "'NAME:priority=P:deadline_ms=D:target=F' entries (lower "
+        "priority = more important; deadline_ms is the class default a "
+        "per-request deadline overrides; target is the attainment "
+        "fraction run_report --serve gates on).  Empty = one 'default' "
+        "class.  E.g. 'gold:priority=0:deadline_ms=250:target=0.99,"
+        "batch:priority=2'",
+    )
+    parser.add_argument(
+        "--serve-warm-buckets",
+        type=str,
+        default="",
+        help="Bucket subset to warm at startup (comma-separated; empty = "
+        "the whole ladder) — the deployment shape 'warm my expected "
+        "traffic'; a flash crowd landing on an unwarmed bucket trips the "
+        "recompilation sentinel (and, under a rewarm_serve --policy "
+        "rule, re-warms the fleet)",
+    )
+    parser.add_argument(
+        "--serve-aot-cache",
+        type=str,
+        default="auto",
+        help="Persisted AOT executable store (utils/compile_cache.py): "
+        "serve bucket programs serialize under their CompileMonitor "
+        "fingerprint so a cold replica deserializes its ladder in "
+        "milliseconds instead of recompiling.  'auto' = <ckpt-path>/"
+        "serve-aot, 'off' = disabled, anything else = explicit directory",
+    )
+    parser.add_argument(
+        "--serve-shape",
+        type=str,
+        default="auto",
+        choices=("auto", "closed", "open", "flash", "diurnal", "mixed"),
+        help="Load shape: 'auto' (open loop when --serve-rate > 0, else "
+        "closed), 'flash' (rate step x--serve-flash-mult for the middle "
+        "third, per-phase latency in the report), 'diurnal' (sinusoidal "
+        "ramp to 4x base), 'mixed' (one open loop per SLO class)",
+    )
+    parser.add_argument(
+        "--serve-flash-mult",
+        type=float,
+        default=8.0,
+        help="Flash-crowd rate multiplier for --serve-shape flash",
     )
     parser.add_argument(
         "--queue-limit",
@@ -1034,4 +1104,36 @@ def load_config(
             f"{args.serve_buckets!r}"
         )
     args.serve_buckets = buckets
+    try:
+        warm = tuple(
+            sorted(
+                {int(t) for t in args.serve_warm_buckets.split(",") if t.strip()}
+            )
+        )
+    except ValueError:
+        parser.error(
+            f"--serve-warm-buckets must be integers, got "
+            f"{args.serve_warm_buckets!r}"
+        )
+    bad = [b for b in warm if b not in buckets]
+    if bad:
+        parser.error(
+            f"--serve-warm-buckets {bad} not in the --serve-buckets "
+            f"ladder {list(buckets)}"
+        )
+    args.serve_warm_buckets = warm
+    if args.serve_replicas < 0:
+        parser.error(
+            f"--serve-replicas must be >= 0 (0 = planner-sized), got "
+            f"{args.serve_replicas}"
+        )
+    if args.serve_classes:
+        # a malformed SLO class table dies at the CLI, like --alert and
+        # --policy specs
+        from .serve.batcher import SLOClassError, parse_slo_classes
+
+        try:
+            parse_slo_classes(args.serve_classes)
+        except SLOClassError as e:
+            parser.error(str(e))
     return args

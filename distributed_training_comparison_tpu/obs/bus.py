@@ -96,6 +96,13 @@ KNOWN_KINDS = {
     # run_report --plan fails a stream whose installed plan disagrees
     # with the attempt's run_start layout
     "plan",
+    # serving fleet (serve/router): `replica` = one replica's lifecycle
+    # (starting/ready/draining/stopped/dead transitions + rate-limited
+    # heartbeats); `serve_route` = the router's periodic routing summary
+    # — cumulative per-SLO-class counters + per-replica counts + the
+    # installed capacity plan — the stream-only input of
+    # `run_report --serve`'s attainment gate
+    "replica", "serve_route",
 }
 
 

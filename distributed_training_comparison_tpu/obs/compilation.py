@@ -286,6 +286,24 @@ class CompileMonitor:
         )
         return compiled, rec
 
+    def adopt_compile(self, name: str, parts, compiled, *, load_s: float = 0.0):
+        """Observe an executable that was NOT compiled here — it was
+        deserialized from the persisted serve AOT cache
+        (``utils/compile_cache.py``).  Emits the same ``compile`` event
+        shape with ``cache: "persisted"`` and the load seconds where the
+        compile seconds would be, so the ledger records the warm-start's
+        measured compile-time drop; ``sentinel=False`` always — a
+        millisecond-scale deserialization is not a compile cliff, so a
+        flash crowd landing on a persisted (if unwarmed) bucket must not
+        page the recompilation sentinel.  Returns the record (None when
+        disabled)."""
+        if not self.enabled:
+            return None
+        return self._record_compile(
+            name, fingerprint_of(name, parts), load_s,
+            compiled, "persisted", False,
+        )
+
     def time_dispatch(self, record: ExecutableRecord | None):
         """Context manager recording one dispatch span into the record's
         ``exec/...`` sketch (serve's hot path; instrumented functions do
@@ -369,6 +387,10 @@ class CompileMonitor:
                 self.registry.counter("compile/persistent_cache_hits").inc()
             elif cache == "miss":
                 self.registry.counter("compile/persistent_cache_misses").inc()
+            elif cache == "persisted":
+                # not a compile at all: a serve executable deserialized
+                # from the persisted AOT store (utils/compile_cache.py)
+                self.registry.counter("compile/persisted_loads").inc()
             if flagged:
                 self.registry.counter("compile/recompiles_after_warmup").inc()
             self.registry.gauge("compile/executables").set(n_execs)

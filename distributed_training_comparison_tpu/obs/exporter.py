@@ -34,12 +34,43 @@ CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 PREFIX = "dtc_"
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+# a trailing `{key=value,...}` suffix on a bus metric name is a LABEL
+# set (the per-SLO-class serving series `serve/latency_s{class=gold}`),
+# rendered as real OpenMetrics labels rather than mangled into the name
+_LABEL_RE = re.compile(r"^(?P<base>[^{]+)\{(?P<labels>[^}]*)\}$")
+
+
+def split_labels(name: str) -> tuple[str, dict[str, str]]:
+    """``serve/latency_s{class=gold}`` → (``serve/latency_s``,
+    ``{"class": "gold"}``); names without a label suffix pass through."""
+    m = _LABEL_RE.match(str(name))
+    if not m:
+        return str(name), {}
+    labels: dict[str, str] = {}
+    for pair in m.group("labels").split(","):
+        key, sep, val = pair.partition("=")
+        if not sep or not key.strip():
+            return str(name), {}  # not label syntax; leave the name alone
+        labels[key.strip()] = val.strip()
+    return m.group("base"), labels
+
+
+def _label_str(labels: dict[str, str], extra: str | None = None) -> str:
+    parts = [
+        f'{_NAME_RE.sub("_", k)}="{_escape(v)}"'
+        for k, v in sorted(labels.items())
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
 
 
 def openmetrics_name(name: str) -> str:
     """A bus metric name (``serve/latency_s``) as a legal OpenMetrics
-    family name (``dtc_serve_latency_s``)."""
-    base = _NAME_RE.sub("_", str(name))
+    family name (``dtc_serve_latency_s``); label suffixes are stripped
+    here (rendered separately via :func:`split_labels`)."""
+    base, _ = split_labels(name)
+    base = _NAME_RE.sub("_", base)
     if not base or not (base[0].isalpha() or base[0] in "_:"):
         base = "_" + base
     return PREFIX + base
@@ -59,22 +90,29 @@ def _escape(label_value: str) -> str:
     )
 
 
-def _histogram_lines(name: str, snap: dict) -> list[str]:
+def _histogram_lines(
+    name: str, snap: dict, labels: dict[str, str] | None = None
+) -> list[str]:
     """Cumulative ``le`` series from the sparse log-bucket sketch: bucket
     index k covers (10^(k/bpd), 10^((k+1)/bpd)], so its upper bound is
     exact; zero/negative samples sit below every bound and therefore
-    count into all of them."""
+    count into all of them.  ``labels`` (the per-class series) merge
+    into every sample's label set next to ``le``."""
+    labels = labels or {}
     bpd = snap.get("bpd", BPD_DEFAULT)
-    lines = [f"# TYPE {name} histogram"]
+    plain = _label_str(labels)
+    lines = []
     cum = int(snap.get("zeros", 0))
     for k in sorted((snap.get("buckets") or {}), key=int):
         cum += int(snap["buckets"][k])
         bound = 10.0 ** ((int(k) + 1) / bpd)
-        lines.append(f'{name}_bucket{{le="{bound:.6g}"}} {cum}')
+        le = _label_str(labels, extra=f'le="{bound:.6g}"')
+        lines.append(f"{name}_bucket{le} {cum}")
     count = int(snap.get("count", 0))
-    lines.append(f'{name}_bucket{{le="+Inf"}} {count}')
-    lines.append(f"{name}_count {count}")
-    lines.append(f"{name}_sum {_fmt(snap.get('sum', 0.0))}")
+    inf = _label_str(labels, extra='le="+Inf"')
+    lines.append(f"{name}_bucket{inf} {count}")
+    lines.append(f"{name}_count{plain} {count}")
+    lines.append(f"{name}_sum{plain} {_fmt(snap.get('sum', 0.0))}")
     return lines
 
 
@@ -88,23 +126,44 @@ def render_openmetrics(
     count/sum), plus the liveness and alert families.  Always terminated
     by ``# EOF`` as the spec requires."""
     lines: list[str] = []
+    # label-suffixed names (serve/latency_s{class=gold}) share ONE
+    # OpenMetrics family with their base series — group them so each
+    # family gets exactly one `# TYPE` line (strict parsers reject
+    # duplicates) with every label variant's samples under it
+    families: dict[str, list] = {}
     for raw_name in sorted(metrics or {}):
         snap = (metrics or {})[raw_name]
         if not isinstance(snap, dict):
             continue
-        name = openmetrics_name(raw_name)
-        kind = snap.get("type")
+        base, labels = split_labels(raw_name)
+        families.setdefault(openmetrics_name(base), []).append(
+            (labels, snap)
+        )
+    for name in sorted(families):
+        variants = families[name]
+        kind = variants[0][1].get("type")
         if kind == "counter":
             lines.append(f"# TYPE {name} counter")
-            lines.append(f"{name}_total {_fmt(snap.get('n', 0))}")
+            for labels, snap in variants:
+                lines.append(
+                    f"{name}_total{_label_str(labels)} {_fmt(snap.get('n', 0))}"
+                )
         elif kind == "gauge":
-            value = snap.get("value")
-            if value is None:
+            samples = [
+                (labels, snap) for labels, snap in variants
+                if snap.get("value") is not None
+            ]
+            if not samples:
                 continue
             lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {_fmt(value)}")
+            for labels, snap in samples:
+                lines.append(
+                    f"{name}{_label_str(labels)} {_fmt(snap['value'])}"
+                )
         elif kind == "histogram":
-            lines.extend(_histogram_lines(name, snap))
+            lines.append(f"# TYPE {name} histogram")
+            for labels, snap in variants:
+                lines.extend(_histogram_lines(name, snap, labels))
     if heartbeat_ages:
         name = PREFIX + "heartbeat_age_seconds"
         lines.append(f"# TYPE {name} gauge")

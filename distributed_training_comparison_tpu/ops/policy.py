@@ -30,10 +30,12 @@ metric).  Actions:
                     attempt, and re-renders the world without the host).
                     The host is resolved from the alert's source process
                     through ``fleet/status.json``'s rank→host map.
-``rewarm_serve``    re-run ``ServeEngine.warmup()`` on the affected
-                    bucket subset after a post-warmup recompile storm
-                    (in-process serving action; the serve session binds
-                    it).
+``rewarm_serve``    re-run ``warmup()`` on the affected bucket subset of
+                    EVERY ready replica of the routed serving fleet
+                    after a post-warmup recompile storm (in-process
+                    serving action; the serve session binds it via
+                    :func:`serve_actions`, whose per-replica report
+                    rides the ``completed`` policy event).
 ``rollback``        the existing watchdog rollback path (verified
                     restore + replay).  Supervisor-side this defers
                     through the request channel below; the trainer
@@ -667,6 +669,23 @@ def supervisor_actions(
         "abort_with_evidence": abort_with_evidence,
         "replan": replan,
     }
+
+
+# ---------------------------------------------------- serving executors
+
+
+def serve_actions(router) -> dict:
+    """The serving-process executor set: ``rewarm_serve`` targets the
+    whole replica fleet — every ready replica re-runs ``warmup()`` on
+    its affected bucket subset (``ServeRouter.rewarm``; a single-engine
+    session passes a one-replica router) and the per-replica report
+    lands in the ``completed`` policy event, so the stream shows WHICH
+    replicas re-warmed WHAT."""
+
+    def rewarm_serve(decision: dict) -> dict:
+        return router.rewarm()
+
+    return {"rewarm_serve": rewarm_serve}
 
 
 # ------------------------------------------------- offline (run_report)

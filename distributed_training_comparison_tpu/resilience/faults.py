@@ -342,6 +342,8 @@ _SPIKE_POLICY = f"{_SPIKE_ALERT} -> rollback:cooldown=300"
 _SKIP_ALERT = "train/skipped_steps:n>0:for=1"
 _ABORT_ALERT = "train/loss:p95>-1:for=1"  # always-breaching tripwire
 _ABORT_POLICY = f"{_ABORT_ALERT} -> abort_with_evidence:cooldown=600"
+_SENTINEL_ALERT = "compile/recompiles_after_warmup:n>0:for=1"
+_REWARM_POLICY = f"{_SENTINEL_ALERT} -> rewarm_serve:cooldown=5"
 
 # Named scenarios composing preempt x straggler-stall x corrupt-shard
 # (nan_grad) x host-flap, each run end-to-end under the fleet supervisor
@@ -363,6 +365,9 @@ _ABORT_POLICY = f"{_ABORT_ALERT} -> abort_with_evidence:cooldown=600"
 #   expect       scoreboard expectations, checked by
 #                ``check_chaos_expectations``:  key / key__min / key__max
 #   require_kinds  event kinds the scenario's stream must carry
+#   session      (optional) "serve" runs the real --serve entry instead
+#                of the training fleet worker — the flash-crowd x serve
+#                axis; its extra_args ARE the whole serve CLI
 CHAOS_SCENARIOS: dict[str, dict] = {
     "straggler_drain": {
         "desc": "persistent straggler on host 1 -> dispatch alert -> "
@@ -503,6 +508,39 @@ CHAOS_SCENARIOS: dict[str, dict] = {
             "restarts": 0, "crash_dump_evidence": True,
         },
         "require_kinds": ("policy", "abort"),
+    },
+    "serve_flash_rewarm": {
+        "desc": "flash crowd lands on an unwarmed serve bucket -> "
+                "recompile storm trips the sentinel alert -> policy "
+                "rewarm_serve re-warms the replica fleet -> p99 recovers "
+                "after the flash",
+        # the serve session (session: "serve"): bench.py --chaos runs the
+        # real --serve entry instead of the training fleet worker.  Warm
+        # buckets 1,2 only; the flash's queue depth reaches bucket 8 —
+        # a mid-serving compile cliff, exactly the storm rewarm_serve
+        # exists for.  The AOT persistence is OFF here on purpose: a
+        # persisted-cache hit is a millisecond load that deliberately
+        # does NOT page the sentinel, and this scenario proves the page.
+        "session": "serve",
+        "fault_plan": None,
+        "alerts": (_SENTINEL_ALERT,),
+        "policies": (_REWARM_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {},
+        "extra_args": (
+            "--serve", "--serve-shape", "flash", "--serve-rate", "6",
+            "--serve-flash-mult", "8", "--serve-requests", "180",
+            "--serve-buckets", "1,2,8", "--serve-warm-buckets", "1,2",
+            "--serve-mode", "continuous", "--serve-aot-cache", "off",
+            "--queue-limit", "512",
+        ),
+        "expect": {
+            "final_rc": 0, "alerts_fired__min": 1,
+            "policy_completed__min": 1, "recompiles__min": 1,
+            "p99_recovered": True, "policy_dry_run": 0,
+        },
+        "require_kinds": ("serve", "serve_route", "policy", "compile"),
     },
 }
 

@@ -1,21 +1,32 @@
-"""Serving subsystem: batched, sharded inference + a load-generating bench.
+"""Serving subsystem: a routed, SLO-classed, continuously-batched
+inference fleet + load-generating bench.
 
 The train side of this repo ends at the Trainer's eval loop; this package
 is the inference path the ROADMAP's "serves heavy traffic" north star
 asks for, built on the same assets — the SPMD mesh/sharding layer, the
 Pallas kernels, and ``train/checkpoint.py``'s files:
 
-- ``engine.py``   — per-bucket AOT-compiled, donated-buffer predict over
-                    any mesh layout training produces (DP/TP/MoE);
-- ``batcher.py``  — request queue + micro-batcher with coalescing,
-                    per-request deadlines, and typed load shedding;
-- ``loadgen.py``  — closed-loop and open-loop (Poisson) load generators;
-- ``metrics.py``  — p50/p95/p99 latency, throughput, queue depth, shed
-                    counts, wired into ``utils/{logging,tensorboard}``.
+- ``engine.py``   — per-bucket AOT-compiled predict over any mesh layout
+                    training produces (DP/TP/MoE); donates nothing, so
+                    executables persist (``utils/compile_cache.py``) and
+                    a cold replica warm-starts by fingerprint;
+- ``batcher.py``  — the SLO-class request queue (priority + deadline +
+                    class-aware shedding), continuous and bucketed
+                    admission, the single-worker ``MicroBatcher``;
+- ``router.py``   — the serving fleet: N health-checked replicas over
+                    one shared queue, drain-on-preempt, ledger-scored
+                    sizing (``plan_serve``), ``serve_route``/``replica``
+                    events;
+- ``loadgen.py``  — closed/open loops + diurnal ramps, flash crowds,
+                    mixed tenancy;
+- ``metrics.py``  — global and per-class latency series, throughput,
+                    queue depth, shed counts, wired into
+                    ``utils/{logging,tensorboard}`` and the obs bus.
 
 ``serve_main`` is the CLI entry behind ``--serve`` (``entry.py`` /
-``src/tpu_jax/run_serve.sh``): build the engine from the run's flags and
-checkpoint dir, drive it with the configured load shape, and report.
+``src/tpu_jax/run_serve.sh``): build the replica fleet from the run's
+flags and checkpoint dir, drive it with the configured load shape, and
+report.
 """
 
 from __future__ import annotations
@@ -25,37 +36,67 @@ import warnings
 import jax.numpy as jnp
 
 from .batcher import (
+    DEFAULT_CLASS,
     BatcherClosed,
+    ClassQueue,
     DeadlineExceeded,
     MicroBatcher,
     QueueOverflow,
+    ReplicaDead,
     ServeError,
     ServeFuture,
+    SLOClass,
+    SLOClassError,
+    parse_slo_classes,
 )
 from .engine import DEFAULT_BUCKETS, ServeEngine
-from .loadgen import closed_loop, open_loop, request_pool
+from .loadgen import (
+    closed_loop,
+    diurnal_ramp,
+    flash_crowd,
+    mixed_tenants,
+    open_loop,
+    open_loop_profile,
+    request_pool,
+)
 from .metrics import ServeMetrics, latency_summary_ms
+from .router import ServeRouter, plan_serve
 
 __all__ = [
     "ServeEngine",
     "DEFAULT_BUCKETS",
     "MicroBatcher",
+    "ClassQueue",
+    "ServeRouter",
+    "plan_serve",
     "ServeFuture",
     "ServeError",
     "QueueOverflow",
     "DeadlineExceeded",
     "BatcherClosed",
+    "ReplicaDead",
+    "SLOClass",
+    "SLOClassError",
+    "parse_slo_classes",
+    "DEFAULT_CLASS",
     "ServeMetrics",
     "latency_summary_ms",
     "closed_loop",
     "open_loop",
+    "open_loop_profile",
+    "diurnal_ramp",
+    "flash_crowd",
+    "mixed_tenants",
     "request_pool",
     "build_engine",
     "serve_main",
 ]
 
 
-def build_engine(hparams, mesh=None, monitor=None) -> ServeEngine:
+def build_engine(
+    hparams, mesh=None, monitor=None, aot_cache=None,
+    arm_sentinel: bool = True,
+) -> ServeEngine:
     """A ``ServeEngine`` from a parsed flag namespace (``config.py``).
 
     Model construction mirrors the Trainer's flag mapping (dtype from
@@ -109,15 +150,92 @@ def build_engine(hparams, mesh=None, monitor=None) -> ServeEngine:
         precision=compute,
         image_size=image_size,
         monitor=monitor,
+        aot_cache=aot_cache,
+        arm_sentinel=arm_sentinel,
     )
 
 
+def serve_aot_cache_from_hparams(hparams):
+    """The ``--serve-aot-cache`` flag resolved to a
+    ``utils.PersistedServeCache`` (or None): ``off`` disables, ``auto``
+    keys the store under the checkpoint root (``<ckpt>/serve-aot``) so a
+    relaunched replica fleet finds its predecessors' executables, any
+    other value is an explicit directory."""
+    spec = str(getattr(hparams, "serve_aot_cache", "auto") or "off")
+    if spec == "off":
+        return None
+    from pathlib import Path
+
+    from ..utils import PersistedServeCache
+
+    if spec == "auto":
+        root = getattr(hparams, "ckpt_path", None)
+        if not root:
+            return None
+        return PersistedServeCache(Path(root) / "serve-aot")
+    return PersistedServeCache(spec)
+
+
+def _run_load_shape(hparams, router, images, deadline) -> dict:
+    """Dispatch the configured traffic shape against the router."""
+    shape = str(getattr(hparams, "serve_shape", "auto") or "auto")
+    rate = float(getattr(hparams, "serve_rate", 0.0) or 0.0)
+    n = int(hparams.serve_requests)
+    seed = int(hparams.seed)
+    if shape == "auto":
+        shape = "open" if rate > 0 else "closed"
+    if shape == "closed":
+        return closed_loop(
+            router, images, num_requests=n,
+            concurrency=hparams.serve_concurrency, deadline_ms=deadline,
+        )
+    base = rate if rate > 0 else 64.0
+    if shape == "open":
+        return open_loop(
+            router, images, rate_rps=base, num_requests=n,
+            deadline_ms=deadline, seed=seed,
+        )
+    if shape == "flash":
+        return flash_crowd(
+            router, images, base_rps=base,
+            flash_mult=float(getattr(hparams, "serve_flash_mult", 8.0)),
+            num_requests=n, deadline_ms=deadline, seed=seed,
+        )
+    if shape == "diurnal":
+        return diurnal_ramp(
+            router, images, base_rps=base, peak_rps=4.0 * base,
+            num_requests=n, deadline_ms=deadline, seed=seed,
+        )
+    if shape == "mixed":
+        # one open loop per DECLARED SLO class, rate split evenly — the
+        # auto-appended synthetic 'default' class gets no tenant of its
+        # own (it exists so class-less submit() works, not as traffic;
+        # splitting the rate with a phantom tenant would measure every
+        # declared class at the wrong offered rate)
+        names = [
+            n for n in sorted(router.classes) if n != DEFAULT_CLASS
+        ] or [DEFAULT_CLASS]
+        tenants = {
+            name: {"rate_rps": base / len(names),
+                   "num_requests": max(1, n // len(names)),
+                   # the flag-level deadline rides along (None falls
+                   # back to each class's own default at submit time)
+                   "deadline_ms": deadline}
+            for name in names
+        }
+        return mixed_tenants(router, images, tenants=tenants, seed=seed)
+    raise ValueError(f"unknown --serve-shape {shape!r}")
+
+
 def serve_main(hparams) -> dict:
-    """The ``--serve`` entry: engine + batcher + load generator + report.
+    """The ``--serve`` entry: replica fleet + load shape + report.
 
     Artifacts mirror a training run's: one log line per phase via the
-    experiment logger, TB scalars under ``<ckpt-path>/serve-tb``, and the
-    report dict returned (``entry.run`` prints it on process 0).
+    experiment logger, TB scalars under ``<ckpt-path>/serve-tb``, the
+    run-event stream (``serve_route``/``replica``/``compile``/``metrics``
+    kinds + the closing ``serve`` summary) in the ckpt root's
+    events.jsonl, and the report dict returned (``entry.run`` prints it
+    on process 0).
     """
     from pathlib import Path
 
@@ -127,16 +245,16 @@ def serve_main(hparams) -> dict:
     from ..utils import setup_logger
 
     if jax.process_count() > 1:
-        # Each process would run its own batcher/load generator with
-        # independently-timed coalescing windows — mismatched bucket
-        # programs across hosts deadlock the sharded executables.  Serving
-        # is single-controller until a cross-host dispatch protocol exists.
+        # Each process would run its own router/load generator with
+        # independently-timed admission — mismatched bucket programs
+        # across hosts deadlock the sharded executables.  Serving is
+        # single-controller until a cross-host dispatch protocol exists.
         raise ValueError(
             "--serve is single-process: run it on one host (a multi-host "
             "launch would dispatch desynchronized bucket programs)"
         )
     logger = setup_logger(None, is_main_process=is_main_process())
-    # obs wiring happens BEFORE the engine exists so the warmup compiles
+    # obs wiring happens BEFORE the engines exist so the warmup compiles
     # are observed: the bus buffers pre-bind emits and flushes them when
     # the ckpt root binds below, so nothing from engine construction is
     # lost.  The compile monitor gives every bucket compile a `compile`
@@ -153,47 +271,107 @@ def serve_main(hparams) -> dict:
     monitor = obs.CompileMonitor(
         bus=bus, registry=registry, enabled=bus is not None
     )
-    engine = build_engine(hparams, monitor=monitor)
-    ck = engine.checkpoint_meta
-    logger.info(
-        f"[serve] model {hparams.model}, mesh {dict(engine.mesh.shape)}, "
-        f"buckets {list(engine.buckets)}, "
-        + (
-            f"checkpoint epoch {ck['epoch']} (acc {ck['acc']:.4f})"
-            if ck
-            else "fresh weights (no checkpoint)"
-        )
-    )
-    engine.warmup()
-    logger.info(
-        f"[serve] warm: {engine.stats()['compiles']} bucket programs compiled"
-    )
+    aot_cache = serve_aot_cache_from_hparams(hparams)
+    classes = parse_slo_classes(getattr(hparams, "serve_classes", None))
+    buckets = tuple(getattr(hparams, "serve_buckets", DEFAULT_BUCKETS))
+    warm = getattr(hparams, "serve_warm_buckets", ()) or None
 
-    images = request_pool(
-        max(256, engine.max_bucket),
-        image_size=engine.image_size,
-        seed=hparams.seed,
-    )
-    # bind the run-event bus so the buffered warmup `compile` events and
-    # the periodic `metrics` events the session emits (latency-histogram
-    # deltas + queue gauges — the live SLO feed `run_report --follow`
-    # tails) land in the ckpt root's events.jsonl
+    # --- replica count + ladder: flag-pinned, or scored by the planner's
+    # ledger-fit cost model over the committed event history (the AMP
+    # argument: configuration from a cost model, not a grid of flags)
+    n_replicas = int(getattr(hparams, "serve_replicas", 1) or 0)
+    plan = None
+    if n_replicas < 1:
+        from ..parallel.planner import load_ledger_events
+
+        plan = plan_serve(
+            load_ledger_events(hparams.ckpt_path),
+            buckets=buckets,
+            rate_rps=float(getattr(hparams, "serve_rate", 0.0) or 0.0),
+            classes=classes,
+        )
+        n_replicas = plan["replicas"]
+        buckets = tuple(plan["buckets"]) or buckets
+        logger.info(
+            f"[serve] plan: {n_replicas} replica(s), ladder "
+            f"{list(buckets)} (sized_by {plan['sized_by']}, fit "
+            f"{plan['fit']['source']})"
+        )
+        if warm:
+            # config.py validated warm against the FLAG ladder; the plan
+            # may have trimmed buckets out from under it, and warming a
+            # bucket the engines no longer carry would kill every
+            # replica at startup
+            kept = tuple(b for b in warm if b in buckets)
+            if kept != warm:
+                logger.warning(
+                    f"[serve] --serve-warm-buckets "
+                    f"{[b for b in warm if b not in buckets]} dropped: "
+                    f"not in the planner-trimmed ladder {list(buckets)}"
+                )
+            warm = kept or None
+
+    # every replica builds its own engine through this factory (in its
+    # own worker thread, so N replicas warm in parallel); the shared
+    # monitor keys records by fingerprint and the shared persisted cache
+    # means replica 1's compile is replica 2's millisecond load
+    first_engine: list = []
+
+    def engine_factory(rid: int) -> ServeEngine:
+        hp = hparams
+        if tuple(getattr(hp, "serve_buckets", ())) != buckets:
+            import copy
+
+            hp = copy.copy(hparams)
+            hp.serve_buckets = buckets
+        # arm_sentinel=False: the ROUTER arms the shared monitor once,
+        # after the whole fleet warmed — a fast replica must not turn
+        # its siblings' remaining warmup compiles into sentinel findings
+        eng = build_engine(
+            hp, monitor=monitor, aot_cache=aot_cache, arm_sentinel=False
+        )
+        if rid == 0:
+            first_engine.append(eng)
+        return eng
+
+    # bind the run-event bus BEFORE replicas start so warmup `compile`
+    # events and the periodic `metrics`/`serve_route`/`replica` events
+    # (the live SLO feed `run_report --follow` tails) land in the ckpt
+    # root's events.jsonl
     if bus is not None:
         bus.bind_dir(hparams.ckpt_path)
-    # live operations for the serving path: the latency histogram and
+    # live operations for the serving path: the latency histograms and
     # queue/shed gauges mirror into a metric registry the OpenMetrics
-    # endpoint renders (--metrics-port), and the --alert rules evaluate
-    # in-process over the periodic `metrics` emits (serving runs
-    # unsupervised, so there is no fleet watcher to do it).
+    # endpoint renders (--metrics-port), the router's ticker flushes that
+    # registry onto the bus periodically (so compile/* counters — the
+    # recompile-storm sentinel — reach rules MID-session), and the
+    # --alert rules evaluate in-process over those periodic emits
+    # (serving runs unsupervised, so there is no fleet watcher to do it).
     alert_engine = None
     specs = getattr(hparams, "alert", None)
     if specs and bus is not None:
         alert_engine = obs.AlertEngine(obs.parse_alert_specs(specs), bus=bus)
         bus.subscribe(alert_engine.observe_event)
+    metrics = ServeMetrics(bus=bus, registry=registry, classes=classes)
+    router = ServeRouter(
+        engine_factory,
+        replicas=n_replicas,
+        classes=classes,
+        mode=str(getattr(hparams, "serve_mode", "continuous")),
+        max_wait_ms=hparams.max_wait_ms,
+        queue_limit=hparams.queue_limit,
+        metrics=metrics,
+        bus=bus,
+        registry=registry,
+        warm_buckets=warm,
+        plan=plan,
+        monitor=monitor,
+    )
     # closed-loop autopilot for the serving path (ops/policy.py): the one
     # action that lives HERE is rewarm_serve — a post-warmup recompile
     # storm (the sentinel alert above) re-runs warmup() on the affected
-    # bucket subset, turning the compile cliff back into a warmed ladder.
+    # bucket subset of EVERY replica, turning the compile cliff back
+    # into a warmed ladder.
     policy_engine = None
     if bus is not None:
         from ..ops import policy as policy_mod
@@ -202,9 +380,9 @@ def serve_main(hparams) -> dict:
             hparams, bus=bus, log=logger.warning
         )
     if policy_engine is not None:
-        policy_engine.bind(
-            "rewarm_serve", lambda decision: engine.rewarm()
-        )
+        from ..ops.policy import serve_actions
+
+        policy_engine.bind_actions(serve_actions(router))
         bus.subscribe(policy_engine.observe_event)
     exporter = obs.start_exporter(
         getattr(hparams, "metrics_port", 0),
@@ -213,36 +391,42 @@ def serve_main(hparams) -> dict:
     )
     if exporter is not None:
         logger.info(f"[serve] OpenMetrics endpoint on :{exporter.port}/metrics")
-    metrics = ServeMetrics(bus=bus, registry=registry)
     deadline = getattr(hparams, "deadline_ms", 0.0) or None
     try:
-        with MicroBatcher(
-            engine,
-            max_wait_ms=hparams.max_wait_ms,
-            queue_limit=hparams.queue_limit,
-            metrics=metrics,
-        ) as batcher:
-            rate = getattr(hparams, "serve_rate", 0.0)
-            if rate > 0:
-                report = open_loop(
-                    batcher,
-                    images,
-                    rate_rps=rate,
-                    num_requests=hparams.serve_requests,
-                    deadline_ms=deadline,
-                    seed=hparams.seed,
-                )
-            else:
-                report = closed_loop(
-                    batcher,
-                    images,
-                    num_requests=hparams.serve_requests,
-                    concurrency=hparams.serve_concurrency,
-                    deadline_ms=deadline,
-                )
+        router.warmup()
+        # replica 0's factory may have failed while another replica
+        # warmed fine (warmup() only needs ONE ready) — introspect any
+        # replica that actually built an engine
+        eng = first_engine[0] if first_engine else next(
+            r.engine for r in router.replicas if r.engine is not None
+        )
+        ck = eng.checkpoint_meta
+        logger.info(
+            f"[serve] model {hparams.model}, mesh {dict(eng.mesh.shape)}, "
+            f"{n_replicas} replica(s), buckets {list(eng.buckets)} "
+            f"(warmed {list(warm) if warm else 'all'}), "
+            + (
+                f"checkpoint epoch {ck['epoch']} (acc {ck['acc']:.4f})"
+                if ck
+                else "fresh weights (no checkpoint)"
+            )
+        )
+        stats = router.stats().get("engine", {})
+        logger.info(
+            f"[serve] warm: {stats.get('compiles', 0)} bucket programs "
+            f"compiled, {stats.get('persisted_hits', 0)} loaded from the "
+            "persisted AOT cache"
+        )
+        images = request_pool(
+            max(256, max(buckets)),
+            image_size=eng.image_size,
+            seed=hparams.seed,
+        )
+        report = _run_load_shape(hparams, router, images, deadline)
     finally:
         # an aborted session must not leak the listening /metrics port or
         # leave a stale rule engine tapping the process-current bus
+        router.close()
         if exporter is not None:
             exporter.close()
         if alert_engine is not None and bus is not None:
@@ -250,7 +434,11 @@ def serve_main(hparams) -> dict:
         if policy_engine is not None and bus is not None:
             bus.unsubscribe(policy_engine.observe_event)
     metrics.log_summary(logger)
-    report["engine"] = engine.stats()
+    router_stats = router.stats()  # one snapshot: router/engine agree
+    report["router"] = router_stats
+    report["engine"] = router_stats.get("engine", {})
+    if policy_engine is not None:
+        report["policy"] = policy_engine.summary()
     if bus is not None:
         # one closing flush puts the session's compile/* counters and the
         # per-bucket exec/... dispatch sketches on the event stream — the
@@ -259,7 +447,15 @@ def serve_main(hparams) -> dict:
     if is_main_process():
         metrics.write_tensorboard(Path(hparams.ckpt_path) / "serve-tb")
         # one summary record on the unified run-event bus: a serving
-        # session's artifacts join training's on the same timeline schema
-        # (ckpt-root events.jsonl, next to the supervisor's)
-        metrics.emit_event(bus if bus is not None else obs.current_bus())
+        # session's artifacts join training's on the same timeline
+        # schema (ckpt-root events.jsonl, next to the supervisor's) —
+        # carrying the load shape's phase split when there is one, so
+        # the chaos gauntlet can judge p99 recovery from the stream
+        extra = {}
+        if "phases" in report:
+            extra["phases"] = report["phases"]
+            extra["shape"] = report.get("mode")
+        metrics.emit_event(
+            bus if bus is not None else obs.current_bus(), extra=extra
+        )
     return report

@@ -1,25 +1,47 @@
-"""Request queue + micro-batcher: coalescing, deadlines, load shedding.
+"""Request queue + micro-batcher: coalescing, SLO classes, deadlines,
+load shedding — and the continuous-batching fast path.
 
 Serving traffic arrives one request at a time; TPU throughput comes in
-batches.  The micro-batcher bridges the two with the standard coalescing
-rule — dispatch when ``max_batch_size`` requests have gathered **or**
-the oldest queued request has waited ``max_wait_ms``, whichever first —
-so light traffic pays at most the window in added latency and heavy
-traffic rides full buckets.
+batches.  Two admission policies bridge the two:
+
+- **Bucketed** (the classic window): dispatch when ``max_batch_size``
+  requests have gathered **or** the oldest queued request has waited
+  ``max_wait_ms``, whichever first — light traffic pays at most the
+  window in added latency, heavy traffic rides full buckets.
+- **Continuous** (the production fast path): queued requests are
+  admitted into the *next* dispatch at every step boundary — the moment
+  a worker frees, it takes whatever has coalesced (slot-filling the
+  engine's fixed bucket ladder; only the remainder is padded) instead of
+  holding the batch for a window that may never fill.  Under partial
+  load this deletes the flush-timeout tail cliff: the previous dispatch
+  IS the coalescing window, so latency is service time, not service
+  time + ``max_wait_ms``.
+
+Requests carry an **SLO class** (:class:`SLOClass`: priority + default
+deadline + attainment target).  The queue is priority-ordered — a gold
+request queued behind a backlog of batch-tier work dispatches first —
+and shed decisions are class-aware: a full queue sheds the *least
+important* queued request to admit a more important one (the newcomer is
+shed only when nothing queued outranks it).
 
 Degradation is graceful and *typed*:
 
 - ``QueueOverflow`` — raised synchronously at ``submit()`` when queue
-  depth has hit ``queue_limit``.  Rejecting at the door bounds queue
-  delay; without a bound, overload turns into unbounded latency for
-  every request (the classic failure mode this class exists to avoid).
-- ``DeadlineExceeded`` — set on a request whose per-request deadline
-  lapsed while it queued; it is dropped *before* wasting device compute
-  on it.
+  depth has hit ``queue_limit`` and no lower-priority victim exists (or
+  set asynchronously on the evicted victim's future).  Rejecting at the
+  door bounds queue delay; without a bound, overload turns into
+  unbounded latency for every request.
+- ``DeadlineExceeded`` — set on a request whose deadline lapsed while it
+  queued.  Expiry is enforced **at take time**: a dead-on-arrival
+  request is failed the moment the worker would otherwise admit it, so
+  it never occupies a bucket slot or displaces live work from the
+  coalesced batch (each one also bumps the ``serve/shed_total``
+  counter — wasted admission is shed, whatever the failure's type).
 
-One daemon worker thread owns all device work, pulling coalesced batches
-and distributing per-row logits back through ``ServeFuture``s.  Counters
-flow into ``serve/metrics.py``.
+One worker thread per :class:`MicroBatcher` owns all device work; the
+routed multi-replica form (``router.py``) runs N replica workers over
+one shared :class:`ClassQueue`.  Counters flow into
+``serve/metrics.py``.
 """
 
 from __future__ import annotations
@@ -31,6 +53,8 @@ from collections import deque
 import numpy as np
 
 from .metrics import ServeMetrics
+
+DEFAULT_CLASS = "default"
 
 
 class ServeError(Exception):
@@ -49,28 +73,154 @@ class BatcherClosed(ServeError):
     """Submit after close(), or the batcher died with this request queued."""
 
 
+class ReplicaDead(ServeError):
+    """The replica holding this request's in-flight batch was declared
+    dead by the router's health check (its worker stopped heartbeating)."""
+
+
+class SLOClassError(ValueError):
+    """Malformed ``--serve-classes`` spec, or an unknown class name."""
+
+
+class SLOClass:
+    """One tenant class: shed priority, default deadline, SLO target.
+
+    ``priority`` orders both dispatch and shedding — LOWER is more
+    important (0 = platinum).  ``deadline_ms`` is the class default a
+    per-request deadline overrides; ``target`` is the attainment
+    fraction ``run_report --serve`` gates on (completed within deadline
+    ÷ all terminal requests of the class; 0 = no gate).
+    """
+
+    __slots__ = ("name", "priority", "deadline_ms", "target")
+
+    def __init__(
+        self, name: str, priority: int = 1,
+        deadline_ms: float | None = None, target: float = 0.0,
+    ) -> None:
+        self.name = str(name)
+        self.priority = int(priority)
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.target = float(target)
+        if not self.name:
+            raise SLOClassError("SLO class name must be non-empty")
+        if not 0.0 <= self.target <= 1.0:
+            raise SLOClassError(
+                f"SLO class {name!r}: target must be in [0, 1], got {target}"
+            )
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise SLOClassError(
+                f"SLO class {name!r}: deadline_ms must be > 0, got {deadline_ms}"
+            )
+
+    def describe(self) -> dict:
+        return {
+            "priority": self.priority,
+            "deadline_ms": self.deadline_ms,
+            "target": self.target,
+        }
+
+    def __repr__(self) -> str:  # tests / logs
+        return (
+            f"SLOClass({self.name!r}, priority={self.priority}, "
+            f"deadline_ms={self.deadline_ms}, target={self.target})"
+        )
+
+
+def default_classes() -> dict[str, SLOClass]:
+    """The single-tenant degenerate case every pre-SLO caller gets."""
+    return {DEFAULT_CLASS: SLOClass(DEFAULT_CLASS, priority=1)}
+
+
+def parse_slo_classes(spec: str | None) -> dict[str, SLOClass]:
+    """Compile a ``--serve-classes`` flag into the class table.
+
+    Grammar (comma-separated classes, colon-separated fields)::
+
+        gold:priority=0:deadline_ms=250:target=0.99,batch:priority=2
+
+    An empty/None spec yields the single ``default`` class.  A spec that
+    names classes but not ``default`` still gets one appended (priority
+    1) so class-less ``submit()`` calls keep working.
+    """
+    if not spec or not str(spec).strip():
+        return default_classes()
+    out: dict[str, SLOClass] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        name = fields[0].strip()
+        kw: dict = {}
+        for pair in fields[1:]:
+            key, sep, val = pair.partition("=")
+            key, val = key.strip(), val.strip()
+            if not sep or key not in ("priority", "deadline_ms", "target"):
+                raise SLOClassError(
+                    f"--serve-classes {part!r}: unknown field {key!r} "
+                    "(known: priority, deadline_ms, target)"
+                )
+            try:
+                kw[key] = int(val) if key == "priority" else float(val)
+            except ValueError:
+                raise SLOClassError(
+                    f"--serve-classes {part!r}: {key} {val!r} is not a number"
+                ) from None
+        if name in out:
+            raise SLOClassError(f"--serve-classes: duplicate class {name!r}")
+        out[name] = SLOClass(name, **kw)
+    if DEFAULT_CLASS not in out:
+        out[DEFAULT_CLASS] = SLOClass(DEFAULT_CLASS, priority=1)
+    return out
+
+
 class ServeFuture:
-    """Completion handle for one request (result row or typed error)."""
+    """Completion handle for one request (result row or typed error).
 
-    __slots__ = ("_event", "_value", "_error", "submit_t", "done_t", "deadline_t")
+    Resolution is atomic and FIRST-WINS: ``set_result``/``set_error``
+    return True only for the call that resolved the future, so the
+    worker finishing a dispatch and a health ticker failing the same
+    in-flight request (``mark_dead``) can never both record a terminal
+    outcome — the loser's return value is False and it must not count
+    the request anywhere.
+    """
 
-    def __init__(self, submit_t: float, deadline_t: float | None) -> None:
+    __slots__ = (
+        "_event", "_value", "_error", "_resolve_lock", "submit_t",
+        "done_t", "deadline_t", "cls",
+    )
+
+    def __init__(
+        self, submit_t: float, deadline_t: float | None,
+        cls: str = DEFAULT_CLASS,
+    ) -> None:
         self._event = threading.Event()
+        self._resolve_lock = threading.Lock()
         self._value = None
         self._error: BaseException | None = None
         self.submit_t = submit_t
         self.done_t: float | None = None
         self.deadline_t = deadline_t
+        self.cls = cls
 
-    def set_result(self, value) -> None:
-        self._value = value
-        self.done_t = time.monotonic()
-        self._event.set()
+    def set_result(self, value) -> bool:
+        with self._resolve_lock:
+            if self._event.is_set():
+                return False
+            self._value = value
+            self.done_t = time.monotonic()
+            self._event.set()
+            return True
 
-    def set_error(self, err: BaseException) -> None:
-        self._error = err
-        self.done_t = time.monotonic()
-        self._event.set()
+    def set_error(self, err: BaseException) -> bool:
+        with self._resolve_lock:
+            if self._event.is_set():
+                return False
+            self._error = err
+            self.done_t = time.monotonic()
+            self._event.set()
+            return True
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -86,12 +236,278 @@ class ServeFuture:
     def latency_s(self) -> float | None:
         return None if self.done_t is None else self.done_t - self.submit_t
 
+    @property
+    def within_deadline(self) -> bool:
+        """Did this request complete inside its deadline?  (True for
+        deadline-less requests — the SLO attainment numerator.)"""
+        if self.done_t is None:
+            return False
+        return self.deadline_t is None or self.done_t <= self.deadline_t
+
+
+class ClassQueue:
+    """The priority-ordered, deadline-aware request queue the batcher and
+    every router replica pull from.
+
+    Thread-safe; ``submit`` never blocks (full = typed shed decision),
+    ``take`` blocks for the first live request then applies the caller's
+    admission policy (continuous vs bucketed window).  Expired requests
+    are failed at take time — before a bucket slot, never after compute.
+    """
+
+    def __init__(
+        self,
+        *,
+        classes: dict[str, SLOClass] | None = None,
+        limit: int = 256,
+        metrics: ServeMetrics | None = None,
+    ) -> None:
+        self.classes = dict(classes) if classes else default_classes()
+        self.limit = int(limit)
+        if self.limit < 1:
+            raise ValueError("queue limit must be >= 1")
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self._cond = threading.Condition()
+        # one FIFO per priority level; take() walks priorities ascending
+        # (most important first), eviction walks descending
+        self._lanes: dict[int, deque] = {}
+        self._n = 0
+        self._closed = False
+
+    # ------------------------------------------------------------- submit
+
+    def resolve_class(self, cls: str | None) -> SLOClass:
+        slo = self.classes.get(cls if cls is not None else DEFAULT_CLASS)
+        if slo is None:
+            raise SLOClassError(
+                f"unknown SLO class {cls!r} (declared: "
+                f"{sorted(self.classes)})"
+            )
+        return slo
+
+    def submit(
+        self, image: np.ndarray, deadline_ms: float | None = None,
+        cls: str | None = None,
+    ) -> ServeFuture:
+        """Enqueue one request.  Raises ``QueueOverflow`` (typed, load
+        shed) when the queue is at its bound and nothing queued is less
+        important, ``BatcherClosed`` after ``close()``.  A full queue
+        holding lower-priority work sheds the newest least-important
+        entry instead (its future gets the ``QueueOverflow``) — the
+        class-aware shed decision."""
+        slo = self.resolve_class(cls)
+        now = time.monotonic()
+        deadline = deadline_ms if deadline_ms else slo.deadline_ms
+        deadline_t = now + deadline / 1e3 if deadline else None
+        victim = None
+        with self._cond:
+            if self._closed:
+                raise BatcherClosed("submit after close()")
+            if self._n >= self.limit:
+                victim = self._evict_below(slo.priority)
+                if victim is None:
+                    self.metrics.record_shed(slo.name)
+                    raise QueueOverflow(
+                        f"queue depth {self._n} at the configured limit "
+                        f"{self.limit}; {slo.name!r} request shed (nothing "
+                        "queued is lower-priority)"
+                    )
+            fut = ServeFuture(now, deadline_t, cls=slo.name)
+            self._lanes.setdefault(slo.priority, deque()).append(
+                (np.asarray(image), fut)
+            )
+            self._n += 1
+            self._cond.notify()
+        if victim is not None:
+            # resolved OUTSIDE the lock: the victim's waiter may react
+            _, vfut = victim
+            self.metrics.record_shed(vfut.cls)
+            vfut.set_error(
+                QueueOverflow(
+                    f"{vfut.cls!r} request shed: queue full and a "
+                    f"higher-priority {slo.name!r} request arrived"
+                )
+            )
+        return fut
+
+    def _evict_below(self, priority: int):
+        """Pop the newest entry of the least important lane with priority
+        STRICTLY above ``priority`` (= less important), or None."""
+        for p in sorted(self._lanes, reverse=True):
+            if p <= priority:
+                break
+            lane = self._lanes[p]
+            if lane:
+                self._n -= 1
+                return lane.pop()  # newest: it has waited the least
+        return None
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return self._n
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    # --------------------------------------------------------------- take
+
+    def _oldest_submit_t(self) -> float | None:
+        heads = [lane[0][1].submit_t for lane in self._lanes.values() if lane]
+        return min(heads) if heads else None
+
+    def _pop_live(self, batch: list, max_n: int) -> None:
+        """Move up to ``max_n - len(batch)`` live entries into ``batch``
+        in priority order; queued requests whose deadline already lapsed
+        are failed HERE — before dispatch, never after the compute — and
+        counted as shed_total (a burned admission, whatever the type)."""
+        now = time.monotonic()
+        for p in sorted(self._lanes):
+            lane = self._lanes[p]
+            while lane and len(batch) < max_n:
+                image, fut = lane.popleft()
+                self._n -= 1
+                if fut.deadline_t is not None and now > fut.deadline_t:
+                    self.metrics.record_expired(fut.cls, pre_dispatch=True)
+                    fut.set_error(
+                        DeadlineExceeded(
+                            f"deadline lapsed {(now - fut.deadline_t) * 1e3:.1f}"
+                            " ms before dispatch"
+                        )
+                    )
+                    continue
+                batch.append((image, fut))
+            if len(batch) >= max_n:
+                break
+
+    def take(
+        self,
+        max_n: int,
+        *,
+        window_s: float = 0.0,
+        continuous: bool = True,
+        timeout_s: float | None = None,
+    ) -> list | None:
+        """Coalesce the next batch (list of ``(image, future)``).
+
+        - ``continuous=True``: return the moment >= 1 live request is
+          queued, with everything queued up to ``max_n`` — the
+          step-boundary admission (the caller's previous dispatch was
+          the window).
+        - ``continuous=False``: classic bucketed window — after the
+          first request, wait until ``max_n`` have gathered or the
+          OLDEST queued request has waited ``window_s``.
+
+        Returns ``[]`` when ``timeout_s`` elapses with nothing live (a
+        router replica uses this to re-check its drain state), ``None``
+        when the queue is closed and drained.
+        """
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        batch: list = []
+        with self._cond:
+            while True:
+                self._pop_live(batch, max_n)
+                if batch or self._closed:
+                    break
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return []
+                    self._cond.wait(min(remaining, 0.1))
+                else:
+                    self._cond.wait(0.1)
+            if not batch and self._closed and not self._n:
+                return None  # closed and drained
+            if not continuous:
+                # the window is anchored at the OLDEST request's submit
+                # time — a request that already queued behind a slow
+                # batch must not wait another full window on top
+                anchor = min(
+                    [f.submit_t for _, f in batch]
+                    + [t for t in (self._oldest_submit_t(),) if t is not None]
+                )
+                window_end = anchor + window_s
+                while len(batch) < max_n and not self._closed:
+                    remaining = window_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                    self._pop_live(batch, max_n)
+                # a deadline can lapse DURING the window just waited
+                # out: re-check, so an expired request never reaches the
+                # engine (continuous mode's take is instantaneous — only
+                # the windowed path can out-wait a deadline it admitted)
+                now = time.monotonic()
+                live = []
+                for image, fut in batch:
+                    if fut.deadline_t is not None and now > fut.deadline_t:
+                        self.metrics.record_expired(
+                            fut.cls, pre_dispatch=True
+                        )
+                        fut.set_error(
+                            DeadlineExceeded(
+                                "deadline lapsed "
+                                f"{(now - fut.deadline_t) * 1e3:.1f} ms "
+                                "inside the coalescing window"
+                            )
+                        )
+                    else:
+                        live.append((image, fut))
+                batch = live
+            depth_after = self._n
+        if batch:
+            self.metrics.record_batch(len(batch), depth_after)
+        return batch
+
+    # -------------------------------------------------------------- close
+
+    def close(self, drain: bool = True) -> None:
+        with self._cond:
+            self._closed = True
+            if not drain:
+                for lane in self._lanes.values():
+                    while lane:
+                        _, fut = lane.popleft()
+                        self._n -= 1
+                        fut.set_error(
+                            BatcherClosed("batcher closed undrained")
+                        )
+            self._cond.notify_all()
+
+    def fail_all(self, err: BaseException) -> int:
+        """Fail every queued request (router give-up path); returns the
+        count.  Each one is a terminal FAILURE in its class's SLO
+        accounting — abandoned work must drag attainment down."""
+        n = 0
+        failed_cls = []
+        with self._cond:
+            for lane in self._lanes.values():
+                while lane:
+                    _, fut = lane.popleft()
+                    self._n -= 1
+                    if fut.set_error(err):
+                        failed_cls.append(fut.cls)
+                        n += 1
+            self._cond.notify_all()
+        for cls in failed_cls:
+            self.metrics.record_failed(cls)
+        return n
+
 
 class MicroBatcher:
-    """Coalesce submitted requests into engine batches.
+    """Coalesce submitted requests into engine batches (one worker).
 
     ``engine`` needs ``predict_logits(images) -> logits`` and a
     ``max_bucket`` attribute (``ServeEngine``, or a stub in tests).
+    ``mode`` picks the admission policy: ``"bucketed"`` (the classic
+    ``max_wait_ms`` window — the pre-continuous default, kept for the
+    bench baseline and embedders tuned to it) or ``"continuous"`` (the
+    step-boundary fast path).  ``classes`` enables SLO-class routing;
+    absent, everything rides the single ``default`` class.
     """
 
     def __init__(
@@ -102,121 +518,67 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         queue_limit: int = 256,
         metrics: ServeMetrics | None = None,
+        classes: dict[str, SLOClass] | None = None,
+        mode: str = "bucketed",
     ) -> None:
+        if mode not in ("bucketed", "continuous"):
+            raise ValueError(
+                f"mode must be 'bucketed' or 'continuous', got {mode!r}"
+            )
         self.engine = engine
+        self.mode = mode
         self.max_batch_size = int(max_batch_size or engine.max_bucket)
         if self.max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         self.max_wait_s = float(max_wait_ms) / 1e3
-        self.queue_limit = int(queue_limit)
-        if self.queue_limit < 1:
-            raise ValueError("queue_limit must be >= 1")
-        self.metrics = metrics if metrics is not None else ServeMetrics()
-        self._queue: deque = deque()
-        self._cond = threading.Condition()
-        self._closed = False
+        self.metrics = metrics if metrics is not None else ServeMetrics(
+            classes=classes
+        )
+        self.queue = ClassQueue(
+            classes=classes, limit=queue_limit, metrics=self.metrics
+        )
         self._worker = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
         )
         self._worker.start()
 
     # ------------------------------------------------------------- submit
-    def submit(self, image: np.ndarray, deadline_ms: float | None = None) -> ServeFuture:
-        """Enqueue one request.  Raises ``QueueOverflow`` (typed, load
-        shed) when the queue is at its bound, ``BatcherClosed`` after
-        ``close()``."""
-        now = time.monotonic()
-        deadline_t = now + deadline_ms / 1e3 if deadline_ms else None
-        with self._cond:
-            if self._closed:
-                raise BatcherClosed("submit after close()")
-            if len(self._queue) >= self.queue_limit:
-                self.metrics.record_shed()
-                raise QueueOverflow(
-                    f"queue depth {len(self._queue)} at the configured "
-                    f"limit {self.queue_limit}; request shed"
-                )
-            fut = ServeFuture(now, deadline_t)
-            self._queue.append((np.asarray(image), fut))
-            self._cond.notify()
-        return fut
+
+    def submit(
+        self, image: np.ndarray, deadline_ms: float | None = None,
+        cls: str | None = None,
+    ) -> ServeFuture:
+        """Enqueue one request (see :meth:`ClassQueue.submit`)."""
+        return self.queue.submit(image, deadline_ms=deadline_ms, cls=cls)
+
+    @property
+    def queue_limit(self) -> int:
+        return self.queue.limit
 
     @property
     def queue_depth(self) -> int:
-        with self._cond:
-            return len(self._queue)
+        return self.queue.depth
 
     # ------------------------------------------------------------- worker
-    def _take_batch(self) -> list | None:
-        """Block for the first request, then coalesce until the batch is
-        full or the window closes.  None = closed and drained."""
-        with self._cond:
-            while not self._queue and not self._closed:
-                self._cond.wait(0.1)
-            if not self._queue:
-                return None  # closed and drained
-            # the window is anchored at the OLDEST request's submit time —
-            # a request that already queued behind a slow batch must not
-            # wait another full window on top
-            window_end = self._queue[0][1].submit_t + self.max_wait_s
-            while (
-                len(self._queue) < self.max_batch_size and not self._closed
-            ):
-                remaining = window_end - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
-            batch = [
-                self._queue.popleft()
-                for _ in range(min(len(self._queue), self.max_batch_size))
-            ]
-            depth_after = len(self._queue)
-        self.metrics.record_batch(len(batch), depth_after)
-        return batch
 
     def _loop(self) -> None:
         while True:
-            batch = self._take_batch()
+            batch = self.queue.take(
+                self.max_batch_size,
+                window_s=self.max_wait_s,
+                continuous=self.mode == "continuous",
+            )
             if batch is None:
                 return
-            now = time.monotonic()
-            live: list[tuple[np.ndarray, ServeFuture]] = []
-            for image, fut in batch:
-                if fut.deadline_t is not None and now > fut.deadline_t:
-                    self.metrics.record_expired()
-                    fut.set_error(
-                        DeadlineExceeded(
-                            f"deadline lapsed {(now - fut.deadline_t) * 1e3:.1f} ms "
-                            "before dispatch"
-                        )
-                    )
-                else:
-                    live.append((image, fut))
-            if not live:
+            if not batch:
                 continue
-            try:
-                logits = self.engine.predict_logits(
-                    np.stack([img for img, _ in live])
-                )
-            except Exception as e:  # engine failure → fail the batch, keep serving
-                self.metrics.record_error()
-                for _, fut in live:
-                    fut.set_error(e)
-                continue
-            for (_, fut), row in zip(live, logits):
-                fut.set_result(row)
-                self.metrics.record_request_done(fut.latency_s)
+            dispatch_batch(self.engine, batch, self.metrics)
 
     # -------------------------------------------------------------- close
+
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop accepting work; by default let queued requests finish."""
-        with self._cond:
-            self._closed = True
-            if not drain:
-                while self._queue:
-                    _, fut = self._queue.popleft()
-                    fut.set_error(BatcherClosed("batcher closed undrained"))
-            self._cond.notify_all()
+        self.queue.close(drain=drain)
         self._worker.join(timeout)
 
     def __enter__(self) -> "MicroBatcher":
@@ -224,3 +586,31 @@ class MicroBatcher:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> None:
+    """Run one coalesced batch through ``engine`` and resolve its
+    futures — the shared worker body of :class:`MicroBatcher` and every
+    router replica.  Engine failure fails the batch (typed, counted) and
+    the caller keeps serving."""
+    try:
+        logits = engine.predict_logits(
+            np.stack([img for img, _ in batch])
+        )
+    except Exception as e:  # engine failure → fail the batch, keep serving
+        metrics.record_error()
+        for _, fut in batch:
+            if fut.set_error(e):
+                metrics.record_failed(fut.cls)
+        return
+    for (_, fut), row in zip(batch, logits):
+        if not fut.set_result(row):
+            # already failed by mark_dead while this dispatch ran: the
+            # client saw ReplicaDead — recording a completion here would
+            # count the request terminal TWICE and inflate attainment
+            # (set_result is atomic first-wins, so this cannot race)
+            continue
+        metrics.record_request_done(
+            fut.latency_s, cls=fut.cls,
+            within_deadline=fut.within_deadline,
+        )

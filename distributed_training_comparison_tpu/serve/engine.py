@@ -14,9 +14,19 @@ bucket** and amortizes *compilation* over the lifetime of the server:
   executable, and slices the padding back off.  After ``warmup()`` the
   hot path never compiles again — ``stats()`` exposes the compile /
   cache-hit counters so tests (and monitoring) can assert exactly that.
-- **Donated input buffers.**  The padded uint8 batch is staged fresh per
-  call and donated to the executable (``donate_argnums``), so XLA reuses
-  its memory for the activations instead of holding both live.
+- **No donation, persistable executables.**  The predict program donates
+  NOTHING: the fp32 logits could never alias the padded uint8 batch, so
+  the old ``donate_argnums`` was always flagged "not usable" by XLA —
+  dropping it costs nothing and buys executable persistence.  The
+  donated-cache write bar (``_compat.donated_cache_write_barred`` — the
+  jax-pin bug where deserialized DONATED executables corrupt their
+  carries) therefore does not apply to serve programs, which is asserted
+  at the store site, never assumed: ``aot_cache`` (a
+  ``utils.PersistedServeCache``) serializes each bucket executable under
+  the CompileMonitor's stable cross-process fingerprint, and a cold
+  replica deserializes its warmed ladder in milliseconds instead of
+  recompiling it (cache outcome ``"persisted"`` on the compile event —
+  the measured warm-start drop).
 - **bf16 compute over any mesh layout the repo trains.**  Normalization
   + forward run under the model's compute dtype with fp32 logits out,
   exactly the eval-path numerics (``train/step.py``).  Parameters are
@@ -76,6 +86,8 @@ class ServeEngine:
         mean=CIFAR100_MEAN,
         std=CIFAR100_STD,
         monitor=None,
+        aot_cache=None,
+        arm_sentinel: bool = True,
     ) -> None:
         if not buckets:
             raise ValueError("serve buckets must be non-empty")
@@ -155,8 +167,20 @@ class ServeEngine:
         # compile observability (obs/compilation.py CompileMonitor): every
         # bucket compile emits a `compile` event with its cost/memory
         # analysis, and a bucket compiled after warmup() — the serve
-        # bucket-churn failure mode — trips the recompilation sentinel
+        # bucket-churn failure mode — trips the recompilation sentinel.
+        # arm_sentinel=False defers the ARMING to the caller (the router:
+        # N replicas warm the same shared monitor in parallel, and the
+        # first finisher must not turn its siblings' remaining genuine
+        # warmup compiles into sentinel findings)
         self._monitor = monitor
+        self._arm_sentinel = bool(arm_sentinel)
+        # persisted AOT warm-start (utils/compile_cache.py): bucket
+        # executables serialize under their monitor fingerprint, so a
+        # cold replica deserializes the ladder instead of recompiling.
+        # Requires a monitor only for the EVENT; the fingerprint itself
+        # is computed locally from the same parts either way.
+        self._aot_cache = aot_cache
+        self.persisted_hits = 0
         # re-warm bookkeeping (ops/policy.py rewarm_serve): buckets that
         # compiled AFTER warmup() — the recompile storm's footprint, and
         # the subset rewarm() reports having closed
@@ -181,45 +205,83 @@ class ServeEngine:
             else self._repl
         )
 
+    def _exec_identity(self, bucket: int) -> tuple[str, tuple]:
+        """The executable's (family name, fingerprint parts).  The name
+        carries the bucket (like the train runners' ``@k{K}`` suffix) so
+        per-bucket dispatch sketches and the serve capacity planner can
+        read the bucket straight off the compile event."""
+        return (
+            f"serve_predict@b{bucket}",
+            (
+                f"bucket={bucket}",
+                f"image={self.image_size}",
+                f"dtype={jnp.dtype(self.compute_dtype).name}",
+                f"mesh={dict(self.mesh.shape)}",
+            ),
+        )
+
     def _executable(self, bucket: int):
         entry = self._compiled.get(bucket)
         if entry is not None:
             self.cache_hits += 1
             return entry
+        name, parts = self._exec_identity(bucket)
+        # --- persisted AOT warm-start: deserialize before compiling.
+        # The fingerprint is the monitor's own stable cross-process key,
+        # computed locally so the cache works monitor-less too.
+        if self._aot_cache is not None:
+            from ..obs.compilation import fingerprint_of
+
+            fp = fingerprint_of(name, parts)
+            exe, load_s = self._aot_cache.load(fp)
+            if exe is not None:
+                rec = (
+                    self._monitor.adopt_compile(
+                        name, parts, exe, load_s=load_s
+                    )
+                    if self._monitor is not None else None
+                )
+                entry = (exe, rec)
+                self._compiled[bucket] = entry
+                self.persisted_hits += 1
+                return entry
         shape = jax.ShapeDtypeStruct(
             (bucket, self.image_size, self.image_size, 3), jnp.uint8
         )
+        # NO donation: the fp32 logits can never alias the uint8 batch
+        # (XLA flagged the old donation "not usable" on every bucket), and
+        # an undonated executable is what makes persistence legal — the
+        # store site refuses donated programs outright (the
+        # _compat.donated_cache_write_barred jax-pin bug).
         fn = jax.jit(
             self._forward,
             in_shardings=(self._var_sharding, self._input_sharding(bucket)),
             out_shardings=self._repl,
-            donate_argnums=1,  # the engine-owned padded batch buffer
         )
-        import warnings
-
-        with warnings.catch_warnings():
-            # when no output can alias the donated uint8 batch (small
-            # logits), XLA notes the donation was unusable — harmless
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable"
+        build = lambda: fn.lower(self.variables, shape).compile()  # noqa: E731
+        if self._monitor is not None:
+            # sentinel only once THIS engine is past its own warmup: a
+            # late-built replica's warmup compiles are not a storm even
+            # when a sibling already armed the shared monitor
+            exe, rec = self._monitor.aot_compile(
+                name, build, parts=parts, sentinel=self._warmed
             )
-            build = lambda: fn.lower(self.variables, shape).compile()  # noqa: E731
-            if self._monitor is not None:
-                exe, rec = self._monitor.aot_compile(
-                    "serve_predict",
-                    build,
-                    parts=(
-                        f"bucket={bucket}",
-                        f"image={self.image_size}",
-                        f"dtype={jnp.dtype(self.compute_dtype).name}",
-                        f"mesh={dict(self.mesh.shape)}",
-                    ),
-                )
-            else:
-                exe, rec = build(), None
+        else:
+            exe, rec = build(), None
         entry = (exe, rec)
         self._compiled[bucket] = entry
         self.compile_count += 1
+        if self._aot_cache is not None:
+            # donated=(): the explicit no-donation assertion — if this
+            # program ever donates again, store() raises instead of
+            # silently persisting a carry-corrupting executable
+            self._aot_cache.store(
+                fingerprint_of(name, parts)
+                if self._monitor is None or rec is None
+                else rec.fingerprint,
+                exe,
+                donated=(),
+            )
         if self._warmed:
             # a compile cliff in the middle of live serving: remember the
             # bucket so a rewarm_serve policy action knows the affected
@@ -266,7 +328,7 @@ class ServeEngine:
                     )
                 )
             self._warmed = True
-        if self._monitor is not None:
+        if self._monitor is not None and self._arm_sentinel:
             self._monitor.warm()
 
     @property
@@ -351,9 +413,13 @@ class ServeEngine:
     def stats(self) -> dict:
         """Compile/cache counters — the no-recompile contract, observable."""
         with self._lock:
-            return {
+            out = {
                 "buckets": list(self.buckets),
                 "compiles": self.compile_count,
                 "cache_hits": self.cache_hits,
+                "persisted_hits": self.persisted_hits,
                 "bucket_counts": dict(self.bucket_counts),
             }
+            if self._aot_cache is not None:
+                out["aot_cache"] = self._aot_cache.stats()
+            return out

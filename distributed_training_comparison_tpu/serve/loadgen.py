@@ -1,7 +1,7 @@
-"""Load generators: closed-loop concurrency and open-loop Poisson arrivals.
+"""Load generators: closed/open loops plus the millions-of-users shapes.
 
-Two canonical shapes of synthetic traffic (the two ends every serving
-paper measures between):
+Two canonical baselines (the two ends every serving paper measures
+between):
 
 - **Closed loop**: ``concurrency`` clients, each submitting its next
   request the moment the previous one completes.  Measures saturated
@@ -15,18 +15,42 @@ paper measures between):
   to cap.  Arrivals are paced on the clock from a seeded RNG, so a
   run is reproducible.
 
-Both return one report dict (offered/completed/shed/expired, duration,
-throughput, latency percentiles) built from ``serve/metrics.py``.
+And three production shapes on top of the open-loop machinery
+(:func:`open_loop_profile` — Poisson arrivals under a *time-varying*
+rate):
+
+- **Diurnal ramp** (:func:`diurnal_ramp`): a sinusoidal day — the rate
+  swings ``base_rps ↔ peak_rps`` over ``period_s``; the shape
+  autoscaling/planning is sized against.
+- **Flash crowd** (:func:`flash_crowd`): a rate step of ``flash_mult``×
+  for the middle third of the run; the report splits latency by phase
+  (``before`` / ``flash`` / ``after``), which is how the chaos gauntlet
+  proves a recompile storm's p99 recovers after ``rewarm_serve``.
+- **Mixed tenancy** (:func:`mixed_tenants`): one open-loop generator per
+  SLO class, concurrent, each with its own rate/deadline — the shape
+  that exercises priority dispatch and class-aware shedding.
+
+Every generator takes a ``batcher`` that only needs ``submit()`` — the
+single-worker :class:`MicroBatcher` and the routed multi-replica
+``ServeRouter`` drive identically — and returns one report dict
+(offered/completed/shed/expired, duration, throughput, latency
+percentiles) built from ``serve/metrics.py``.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 
 import numpy as np
 
-from .batcher import DeadlineExceeded, MicroBatcher, QueueOverflow, ServeError
+from .batcher import (
+    BatcherClosed,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+)
 from .metrics import latency_summary_ms
 
 
@@ -42,7 +66,7 @@ def request_pool(
 
 def _collect(futures, offered: int, t0: float) -> dict:
     """Wait out in-flight futures and aggregate the run's report."""
-    latencies, completed, expired, failed = [], 0, 0, 0
+    latencies, completed, expired, shed_after, failed = [], 0, 0, 0, 0
     for fut in futures:
         try:
             fut.result(timeout=60.0)
@@ -50,12 +74,19 @@ def _collect(futures, offered: int, t0: float) -> dict:
             latencies.append(fut.latency_s)
         except DeadlineExceeded:
             expired += 1
-        except (ServeError, TimeoutError):
-            # TimeoutError: still in flight after 60 s (hung engine or an
-            # enormous backlog) — count it failed, keep the report
+        except QueueOverflow:
+            # shed AFTER submit returned: a class-eviction victim — the
+            # metrics side counted it shed, so this report must too
+            shed_after += 1
+        except Exception:
+            # a raw engine exception the batch failed with
+            # (dispatch_batch sets it verbatim), ReplicaDead, or
+            # TimeoutError (still in flight after 60 s — hung engine or
+            # an enormous backlog): count it failed, keep the report —
+            # the generator's contract is evidence over abort
             failed += 1
     duration = max(time.monotonic() - t0, 1e-9)
-    shed = offered - len(futures)
+    shed = offered - len(futures) + shed_after
     return {
         "offered": offered,
         "completed": completed,
@@ -75,6 +106,7 @@ def closed_loop(
     num_requests: int = 256,
     concurrency: int = 8,
     deadline_ms: float | None = None,
+    cls: str | None = None,
 ) -> dict:
     """``concurrency`` clients, back-to-back requests, ``num_requests`` total."""
     t0 = time.monotonic()
@@ -92,16 +124,22 @@ def closed_loop(
                 counter["next"] = i + 1
             try:
                 fut = batcher.submit(
-                    images[i % len(images)], deadline_ms=deadline_ms
+                    images[i % len(images)], deadline_ms=deadline_ms,
+                    cls=cls,
                 )
             except QueueOverflow:
                 continue  # shed; counted by offered - len(futures)
+            except BatcherClosed:
+                # fleet gave up / session closing: the door is shut for
+                # good — stop this client, the unsubmitted remainder
+                # counts as shed (evidence over abort)
+                return
             with futures_lock:
                 futures.append(fut)
             try:
                 fut.result(timeout=60.0)
-            except (ServeError, TimeoutError):
-                pass  # tallied in _collect
+            except Exception:  # incl. raw engine errors; tallied in _collect
+                pass
 
     threads = [
         threading.Thread(target=client, daemon=True)
@@ -125,6 +163,7 @@ def open_loop(
     num_requests: int = 256,
     deadline_ms: float | None = None,
     seed: int = 0,
+    cls: str | None = None,
 ) -> dict:
     """Poisson arrivals at ``rate_rps``, ``num_requests`` offered total.
 
@@ -134,25 +173,206 @@ def open_loop(
     """
     if rate_rps <= 0:
         raise ValueError(f"open loop needs rate_rps > 0, got {rate_rps}")
+    report = open_loop_profile(
+        batcher, images, rate_fn=lambda frac: rate_rps,
+        num_requests=num_requests, deadline_ms=deadline_ms, seed=seed,
+        cls=cls,
+    )
+    report["mode"] = "open"
+    report["offered_rps"] = round(rate_rps, 2)
+    return report
+
+
+def open_loop_profile(
+    batcher,
+    images: np.ndarray,
+    *,
+    rate_fn,
+    num_requests: int = 256,
+    deadline_ms: float | None = None,
+    seed: int = 0,
+    cls: str | None = None,
+    phase_fn=None,
+) -> dict:
+    """Poisson arrivals under a time-varying rate — the engine under
+    every production traffic shape.
+
+    ``rate_fn(frac)`` maps request progress ``i / num_requests`` to the
+    instantaneous offered rate (req/s); each gap is drawn exponential at
+    the CURRENT rate, so the arrival process is a (piecewise) Poisson
+    process whose intensity follows the profile.  ``phase_fn(frac)``,
+    when given, names each request's phase; the report then carries a
+    per-phase latency split (how the flash-crowd shape shows a p99 cliff
+    and its recovery).
+    """
     rng = np.random.default_rng(seed)
-    gaps = rng.exponential(1.0 / rate_rps, size=num_requests)
     t0 = time.monotonic()
     futures: list = []
+    phase_of: dict[int, str] = {}
     next_t = t0
     for i in range(num_requests):
-        next_t += gaps[i]
+        frac = i / max(1, num_requests)
+        rate = max(1e-6, float(rate_fn(frac)))
+        next_t += float(rng.exponential(1.0 / rate))
         delay = next_t - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         try:
-            futures.append(
-                batcher.submit(
-                    images[i % len(images)], deadline_ms=deadline_ms
-                )
+            fut = batcher.submit(
+                images[i % len(images)], deadline_ms=deadline_ms, cls=cls
             )
         except QueueOverflow:
-            pass  # shed; the arrival clock keeps running
+            continue  # shed; the arrival clock keeps running
+        except BatcherClosed:
+            # fleet gave up mid-profile: no future offer can land, so
+            # stop arrivals and report what happened up to here —
+            # evidence over abort
+            break
+        if phase_fn is not None:
+            phase_of[id(fut)] = str(phase_fn(frac))
+        futures.append(fut)
     report = _collect(futures, num_requests, t0)
-    report["mode"] = "open"
-    report["offered_rps"] = round(rate_rps, 2)
+    if phase_fn is not None:
+        phases: dict[str, list] = {}
+        for fut in futures:
+            name = phase_of.get(id(fut))
+            if name is None:
+                continue
+            try:
+                fut.result(timeout=0)  # already collected; no wait
+                phases.setdefault(name, []).append(fut.latency_s)
+            except Exception:  # failed/shed/expired: phase counts no sample
+                phases.setdefault(name, [])
+        report["phases"] = {
+            name: {
+                "n": len(lats),
+                "latency_ms": latency_summary_ms([x for x in lats if x]),
+            }
+            for name, lats in phases.items()
+        }
     return report
+
+
+def diurnal_ramp(
+    batcher,
+    images: np.ndarray,
+    *,
+    base_rps: float,
+    peak_rps: float,
+    num_requests: int = 256,
+    periods: float = 1.0,
+    deadline_ms: float | None = None,
+    seed: int = 0,
+    cls: str | None = None,
+) -> dict:
+    """A sinusoidal day compressed into the run: rate swings
+    ``base_rps ↔ peak_rps`` over ``periods`` full cycles."""
+    if not 0 < base_rps <= peak_rps:
+        raise ValueError(
+            f"diurnal ramp needs 0 < base_rps <= peak_rps, got "
+            f"{base_rps}/{peak_rps}"
+        )
+    mid = (peak_rps + base_rps) / 2.0
+    amp = (peak_rps - base_rps) / 2.0
+
+    def rate(frac: float) -> float:
+        return mid - amp * math.cos(2.0 * math.pi * periods * frac)
+
+    report = open_loop_profile(
+        batcher, images, rate_fn=rate, num_requests=num_requests,
+        deadline_ms=deadline_ms, seed=seed, cls=cls,
+    )
+    report["mode"] = "diurnal"
+    report["base_rps"], report["peak_rps"] = base_rps, peak_rps
+    return report
+
+
+def flash_crowd(
+    batcher,
+    images: np.ndarray,
+    *,
+    base_rps: float,
+    flash_mult: float = 8.0,
+    num_requests: int = 256,
+    deadline_ms: float | None = None,
+    seed: int = 0,
+    cls: str | None = None,
+) -> dict:
+    """A rate step: ``base_rps`` for the first third, ``base_rps ×
+    flash_mult`` for the middle third, back to base for the last — with
+    the per-phase latency split in the report (the crowd's p99 cliff and
+    whether it recovered)."""
+    if base_rps <= 0 or flash_mult < 1:
+        raise ValueError(
+            f"flash crowd needs base_rps > 0 and flash_mult >= 1, got "
+            f"{base_rps}/{flash_mult}"
+        )
+
+    def rate(frac: float) -> float:
+        return base_rps * (flash_mult if 1 / 3 <= frac < 2 / 3 else 1.0)
+
+    def phase(frac: float) -> str:
+        return (
+            "before" if frac < 1 / 3 else
+            "flash" if frac < 2 / 3 else "after"
+        )
+
+    report = open_loop_profile(
+        batcher, images, rate_fn=rate, num_requests=num_requests,
+        deadline_ms=deadline_ms, seed=seed, cls=cls, phase_fn=phase,
+    )
+    report["mode"] = "flash"
+    report["base_rps"], report["flash_mult"] = base_rps, flash_mult
+    return report
+
+
+def mixed_tenants(
+    batcher,
+    images: np.ndarray,
+    *,
+    tenants: dict,
+    num_requests: int = 256,
+    seed: int = 0,
+) -> dict:
+    """Concurrent per-class open loops: ``tenants`` maps class name →
+    ``{"rate_rps": R[, "deadline_ms": D, "num_requests": N]}``.  Each
+    tenant paces its own Poisson arrivals in its own thread; the report
+    carries one sub-report per class plus the combined totals."""
+    if not tenants:
+        raise ValueError("mixed_tenants needs at least one tenant")
+    reports: dict[str, dict] = {}
+    threads = []
+    t0 = time.monotonic()
+
+    def run_tenant(name: str, spec: dict, tseed: int) -> None:
+        try:
+            reports[name] = open_loop(
+                batcher, images,
+                rate_rps=float(spec["rate_rps"]),
+                num_requests=int(spec.get("num_requests", num_requests)),
+                deadline_ms=spec.get("deadline_ms"),
+                seed=tseed, cls=name,
+            )
+        except Exception as e:  # a failing tenant must SHOW, not vanish
+            reports[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    for k, (name, spec) in enumerate(sorted(tenants.items())):
+        t = threading.Thread(
+            target=run_tenant, args=(name, spec, seed + k), daemon=True
+        )
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+    duration = max(time.monotonic() - t0, 1e-9)
+    totals = {
+        key: sum(r.get(key, 0) for r in reports.values())
+        for key in ("offered", "completed", "shed", "expired", "failed")
+    }
+    return {
+        "mode": "mixed",
+        "duration_s": round(duration, 3),
+        "throughput_rps": round(totals["completed"] / duration, 2),
+        **totals,
+        "tenants": reports,
+    }

@@ -1,5 +1,5 @@
-"""Serving-side observability: latency percentiles, throughput, queue
-depth, shed counts.
+"""Serving-side observability: latency percentiles (global and
+per-SLO-class), throughput, queue depth, shed counts.
 
 The training side already owns a logger (``utils/logging.py``) and a
 dependency-free TensorBoard event writer (``utils/tensorboard.py``); this
@@ -7,10 +7,11 @@ module aggregates the serving path's per-request/per-batch signals and
 writes them through those same sinks, so a serving run's artifacts look
 like a training run's (log lines + TB scalars under one directory).
 
-All recording methods are called from the micro-batcher's worker thread
-and the load generators' submitter threads concurrently; a single lock
-guards the counters (the hot path appends one float per request — the
-lock is not a bottleneck at the request rates one host can offer).
+All recording methods are called from the micro-batcher/replica worker
+threads and the load generators' submitter threads concurrently; a
+single lock guards the counters (the hot path appends one float per
+request — the lock is not a bottleneck at the request rates one host can
+offer).
 
 Memory contract: raw samples are **reservoir-sampled** past
 ``RESERVOIR_CAP`` (Vitter's algorithm R) — a millions-of-requests run
@@ -18,9 +19,18 @@ keeps a fixed-size uniform sample instead of growing host RAM without
 bound.  Percentiles come off the reservoir (an unbiased estimate);
 counts, means, and maxima stay EXACT via running accumulators.  Every
 latency additionally lands in a log-bucket histogram sketch
-(``obs/metrics.py``), and ``maybe_emit_metrics`` flushes it as periodic
-``metrics`` events on the run-event bus — the live SLO timeline
-``tools/run_report.py --follow`` tails.
+(``obs/metrics.py``) — one global series plus one per SLO class, named
+``serve/latency_s{class=NAME}`` (the OpenMetrics exporter renders the
+brace suffix as a real label) — and ``maybe_emit_metrics`` flushes them
+as periodic ``metrics`` events on the run-event bus: the live per-tenant
+SLO timeline ``tools/run_report.py --follow`` tails.
+
+Per-class SLO accounting is exact (plain counters, never sampled):
+``completed`` / ``ok_deadline`` (completed within the request's
+deadline) / ``expired`` / ``shed`` per class, from which attainment =
+``ok_deadline / (completed + expired + shed)``.  ``class_payload()``
+serializes it for the router's ``serve_route`` events — the
+stream-only input of ``run_report --serve``'s attainment gate.
 """
 
 from __future__ import annotations
@@ -39,6 +49,13 @@ from ..obs.metrics import Histogram, histogram_summary
 RESERVOIR_CAP = 8192
 # default seconds between periodic `metrics` bus events (live SLO feed)
 EMIT_EVERY_S_DEFAULT = 5.0
+
+
+def class_series_name(cls: str) -> str:
+    """The per-class latency series name — a ``{class=...}`` label
+    suffix on the base family, which the OpenMetrics exporter renders as
+    a real label (``dtc_serve_latency_s{class="gold"}``)."""
+    return f"serve/latency_s{{class={cls}}}"
 
 
 def latency_summary_ms(latencies_s) -> dict[str, float]:
@@ -92,17 +109,74 @@ class _Reservoir:
         return self.sum / self.count if self.count else 0.0
 
 
+class _ClassStats:
+    """Exact per-SLO-class accounting + the class latency sketch."""
+
+    __slots__ = (
+        "name", "completed", "ok_deadline", "expired", "shed", "failed",
+        "expired_pre_dispatch", "hist", "reg_hist", "reservoir",
+    )
+
+    def __init__(self, name: str, registry=None) -> None:
+        self.name = name
+        self.completed = 0
+        self.ok_deadline = 0
+        self.expired = 0
+        self.expired_pre_dispatch = 0
+        self.shed = 0
+        self.failed = 0  # engine error / replica death / fleet give-up
+        self.hist = Histogram(class_series_name(name))
+        self.reg_hist = (
+            registry.histogram(class_series_name(name))
+            if registry is not None else None
+        )
+        self.reservoir = _Reservoir()
+
+    @property
+    def terminal(self) -> int:
+        # every way a request can END, failures included: a replica
+        # dying with 50 gold requests in flight must DROP gold's
+        # attainment, not vanish from its denominator
+        return self.completed + self.expired + self.shed + self.failed
+
+    @property
+    def attainment(self) -> float | None:
+        t = self.terminal
+        return self.ok_deadline / t if t else None
+
+    def payload(self, slo=None) -> dict:
+        """The class row a ``serve_route`` event carries — cumulative
+        counters (delta-free, so the LAST event per process is the
+        state) plus the class's SLO config when known."""
+        out = {
+            "completed": self.completed,
+            "ok_deadline": self.ok_deadline,
+            "expired": self.expired,
+            "expired_pre_dispatch": self.expired_pre_dispatch,
+            "shed": self.shed,
+            "failed": self.failed,
+            "attainment": self.attainment,
+            "latency_ms": latency_summary_ms(self.reservoir.values),
+        }
+        if slo is not None:
+            out.update(slo.describe())
+        return out
+
+
 class ServeMetrics:
     """Counters + bounded samples for one serving session.
 
     ``bus`` (optional): a run-event bus to receive periodic ``metrics``
     events with the latency/batch histograms — rate-limited to one event
     per ``emit_every_s``, so a flood of requests cannot flood the bus.
+    ``classes`` (optional): the SLO class table; per-class series exist
+    lazily for whatever class names actually record, so ad-hoc tenant
+    names in tests/loadgen work too.
     """
 
     def __init__(
         self, bus=None, emit_every_s: float = EMIT_EVERY_S_DEFAULT,
-        registry=None,
+        registry=None, classes=None,
     ) -> None:
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
@@ -112,6 +186,7 @@ class ServeMetrics:
         self.completed = 0
         self.shed = 0
         self.expired = 0
+        self.failed = 0
         self.errors = 0
         self.bus = bus
         self.emit_every_s = float(emit_every_s)
@@ -128,7 +203,16 @@ class ServeMetrics:
             registry.histogram("serve/latency_s") if registry is not None
             else None
         )
+        # every burned admission — queue-overflow sheds, class evictions,
+        # AND deadline expiries failed before dispatch — in one counter
+        # an --alert/--policy rule can watch (`serve/shed_total:n>0`)
+        self._reg_shed_total = (
+            registry.counter("serve/shed_total") if registry is not None
+            else None
+        )
         self._registry = registry
+        self.classes = dict(classes) if classes else {}
+        self._class_stats: dict[str, _ClassStats] = {}
 
     # back-compat views: callers/tests read the raw sample lists by name
     @property
@@ -143,12 +227,33 @@ class ServeMetrics:
     def queue_depths(self) -> list[float]:
         return self._queue_depths.values
 
+    def _cls(self, cls: str | None) -> _ClassStats:
+        # under self._lock
+        name = cls or "default"
+        st = self._class_stats.get(name)
+        if st is None:
+            st = self._class_stats[name] = _ClassStats(
+                name, registry=self._registry
+            )
+        return st
+
     # ------------------------------------------------------------ record
-    def record_request_done(self, latency_s: float) -> None:
+    def record_request_done(
+        self, latency_s: float, cls: str | None = None,
+        within_deadline: bool = True,
+    ) -> None:
         with self._lock:
             self.completed += 1
             self._latencies.add(latency_s)
+            st = self._cls(cls)
+            st.completed += 1
+            if within_deadline:
+                st.ok_deadline += 1
+            st.reservoir.add(latency_s)
         self._latency_hist.record(latency_s)
+        st.hist.record(latency_s)
+        if st.reg_hist is not None:
+            st.reg_hist.record(latency_s)
         if self._reg_latency is not None:
             self._reg_latency.record(latency_s)
             self._registry.gauge("serve/completed").set(self.completed)
@@ -161,26 +266,49 @@ class ServeMetrics:
         if self._registry is not None:
             self._registry.gauge("serve/queue_depth").set(int(queue_depth))
 
-    def record_shed(self) -> None:
+    def record_shed(self, cls: str | None = None) -> None:
         with self._lock:
             self.shed += 1
+            self._cls(cls).shed += 1
+        if self._reg_shed_total is not None:
+            self._reg_shed_total.inc()
         if self._registry is not None:
             self._registry.gauge("serve/shed").set(self.shed)
 
-    def record_expired(self) -> None:
+    def record_expired(
+        self, cls: str | None = None, pre_dispatch: bool = False
+    ) -> None:
         with self._lock:
             self.expired += 1
+            st = self._cls(cls)
+            st.expired += 1
+            if pre_dispatch:
+                st.expired_pre_dispatch += 1
+        if pre_dispatch and self._reg_shed_total is not None:
+            # a queued request failed before dispatch is a burned
+            # admission — shed, whatever its failure type
+            self._reg_shed_total.inc()
 
     def record_error(self) -> None:
+        """One failed BATCH (engine exception) — the dispatch-level tally."""
         with self._lock:
             self.errors += 1
+
+    def record_failed(self, cls: str | None = None) -> None:
+        """One failed REQUEST (engine error, replica death, fleet
+        give-up): a terminal outcome that must land in its class's SLO
+        denominator — an attainment gate that never sees failures would
+        report 'all targets met' over a fleet that dropped its traffic."""
+        with self._lock:
+            self.failed += 1
+            self._cls(cls).failed += 1
 
     # ----------------------------------------------------------- report
     def _maybe_emit_metrics(self) -> None:
         """One rate-limited ``metrics`` event on the bus: the latency
-        histogram delta since the last emit + instantaneous gauges — the
-        live SLO timeline (``run_report --follow``) without per-request
-        bus traffic."""
+        histogram deltas (global + per class) since the last emit +
+        instantaneous gauges — the live SLO timeline (``run_report
+        --follow``) without per-request bus traffic."""
         if self.bus is None:
             return
         now = time.monotonic()
@@ -192,19 +320,32 @@ class ServeMetrics:
             # .last, not values[-1]: once the reservoir caps, the list's
             # tail is an arbitrary historical sample, not the newest depth
             depth = self._queue_depths.last
+            class_hists = [st.hist for st in self._class_stats.values()]
         snap = self._latency_hist.snapshot(reset=True)
         if snap is None:
             return
-        self.bus.emit(
-            "metrics",
-            metrics={
-                "serve/latency_s": snap,
-                "serve/queue_depth": {"type": "gauge", "value": depth},
-                "serve/completed": {"type": "gauge", "value": completed},
-                "serve/shed": {"type": "gauge", "value": shed},
-                "serve/expired": {"type": "gauge", "value": expired},
-            },
-        )
+        metrics = {
+            "serve/latency_s": snap,
+            "serve/queue_depth": {"type": "gauge", "value": depth},
+            "serve/completed": {"type": "gauge", "value": completed},
+            "serve/shed": {"type": "gauge", "value": shed},
+            "serve/expired": {"type": "gauge", "value": expired},
+        }
+        for hist in class_hists:
+            csnap = hist.snapshot(reset=True)
+            if csnap is not None:
+                metrics[hist.name] = csnap
+        self.bus.emit("metrics", metrics=metrics)
+
+    def class_payload(self) -> dict:
+        """Per-class cumulative rows for the ``serve_route`` events —
+        the stream-only input of ``run_report --serve``."""
+        with self._lock:
+            stats = dict(self._class_stats)
+        return {
+            name: st.payload(self.classes.get(name))
+            for name, st in stats.items()
+        }
 
     def summary(self) -> dict:
         """One dict with everything a serving report needs.  Percentiles
@@ -216,10 +357,11 @@ class ServeMetrics:
             # the reservoir's percentile estimate, but the EXACT moments
             lat["mean"] = round(self._latencies.mean * 1e3, 3)
             lat["max"] = round(self._latencies.max * 1e3, 3)
-            return {
+            out = {
                 "completed": self.completed,
                 "shed": self.shed,
                 "expired": self.expired,
+                "failed": self.failed,
                 "errors": self.errors,
                 "duration_s": round(elapsed, 3),
                 "throughput_rps": round(self.completed / elapsed, 2),
@@ -232,6 +374,10 @@ class ServeMetrics:
                 "mean_queue_depth": round(self._queue_depths.mean, 2),
                 "max_queue_depth": int(self._queue_depths.max),
             }
+        classes = self.class_payload()
+        if classes and set(classes) != {"default"}:
+            out["classes"] = classes
+        return out
 
     def log_summary(self, logger, prefix: str = "serve") -> dict:
         """Emit the summary as one log line via the experiment logger."""
@@ -247,19 +393,22 @@ class ServeMetrics:
         )
         return s
 
-    def emit_event(self, bus) -> dict:
+    def emit_event(self, bus, extra: dict | None = None) -> dict:
         """One ``serve`` record on the run-event bus (obs/): the same
         summary the log line and the TB scalars carry — plus the latency
         histogram sketch delta since the last periodic flush (sketches
         are delta-semantics everywhere: merging this record with the
         run's ``metrics`` events reconstructs the full distribution; with
         no periodic emits it IS the full distribution) — on the unified
-        timeline schema run_report merges."""
+        timeline schema run_report merges.  ``extra`` (e.g. the load
+        shape's phase report) folds into the payload."""
         hist = self._latency_hist.snapshot(reset=True)
         payload = self.summary()
         if hist is not None:
             payload["latency_hist"] = hist
             payload["latency_hist_summary"] = histogram_summary(hist)
+        if extra:
+            payload.update(extra)
         return bus.emit("serve", **payload)
 
     def write_tensorboard(self, log_dir: str | Path, step: int = 0) -> None:
@@ -278,3 +427,13 @@ class ServeMetrics:
             w.add_scalar("serve/expired", s["expired"], step)
             w.add_scalar("serve/mean_batch_size", s["mean_batch_size"], step)
             w.add_scalar("serve/mean_queue_depth", s["mean_queue_depth"], step)
+            for name, row in (s.get("classes") or {}).items():
+                for k in ("p50", "p99"):
+                    w.add_scalar(
+                        f"serve/{name}/latency_{k}_ms",
+                        row["latency_ms"][k], step,
+                    )
+                if row.get("attainment") is not None:
+                    w.add_scalar(
+                        f"serve/{name}/attainment", row["attainment"], step
+                    )

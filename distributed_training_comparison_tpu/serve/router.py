@@ -1,0 +1,712 @@
+"""The serving fleet: a router over N health-checked engine replicas.
+
+``serve/`` was a single-process bucketed batcher behind one worker; this
+module scales it the way ``resilience/fleet.py`` scales training — a
+supervisor that owns replica lifecycles and re-renders the serving set
+when one goes away:
+
+- **One shared class-aware queue** (``batcher.ClassQueue``): requests
+  carry SLO classes (priority + deadline), every replica pulls from the
+  same priority-ordered queue, so a gold request never waits behind a
+  batch-tier backlog and a drained replica's queued work re-routes for
+  free (it was never pinned to a replica in the first place — zero lost
+  requests by construction).
+- **Replica state machine** (``starting → ready → draining → stopped``,
+  plus ``dead``): each replica owns one engine (its own AOT bucket
+  programs, typically warm-started from the shared persisted cache) and
+  one worker thread that admits queued requests at every step boundary
+  (continuous batching) or per coalescing window (bucketed).  Every
+  transition emits a registered ``replica`` event; workers heartbeat on
+  the same kind (rate-limited), and the router's health ticker declares
+  a replica **dead** when its beat goes stale — in-flight futures fail
+  typed (``ReplicaDead``), queued work simply flows to the survivors.
+- **Preemption drains, fleet-style**: ``drain(rid)`` stops a replica's
+  queue pulls; its in-flight batch completes and resolves, nothing
+  queued is lost — the serving twin of the FleetSupervisor's deliberate
+  drain-and-re-render cycle.
+- **Ledger-scored sizing** (:func:`plan_serve`): replica count and the
+  bucket ladder are priced by the SAME cost model the auto-parallel
+  planner fits to the committed compile ledger (``parallel/planner.py``
+  — AMP's argument, arxiv 2210.07297: configuration from a cost model,
+  not a grid of flags): per-bucket service seconds from the serve
+  executables' measured flops × the fitted seconds-per-flop slope +
+  dispatch overhead, replica count from offered rate ÷ per-replica
+  capacity at a utilization target, ladder trimmed to buckets whose
+  service time fits the tightest class deadline.
+- **One periodic ``serve_route`` event** (plus a final one at close)
+  carrying the cumulative per-class SLO counters, per-replica routing
+  counts, and the installed plan — the stream-only input of
+  ``run_report --serve``'s attainment gate.
+
+The replicas here share one process and one device set (the CPU-CI and
+one-host form; N engines, N worker threads, one jax runtime).  The
+process-per-replica form is the same state machine driven over the same
+events — the bench's cold-start leg runs a replica as a real fresh
+process and proves the warm-start contract end to end.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+import time
+
+from .batcher import (
+    ClassQueue,
+    ReplicaDead,
+    SLOClass,
+    default_classes,
+    dispatch_batch,
+)
+from .metrics import ServeMetrics
+
+REPLICA_KIND = "replica"
+ROUTE_KIND = "serve_route"
+
+# replica states
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"
+STOPPED = "stopped"
+DEAD = "dead"
+
+BEAT_EVERY_S_DEFAULT = 2.0
+HEALTH_TIMEOUT_S_DEFAULT = 60.0
+# target utilization the capacity plan sizes replicas for: headroom for
+# arrival burstiness — M/D/1 queueing delay diverges as rho -> 1
+PLAN_UTILIZATION = 0.7
+
+# per-process router sequence: rides every serve_route event so
+# `run_report --serve` can tell sequential routers of one process apart
+# (their cumulative counters SUM; without the token, last would win)
+_ROUTER_SEQ = itertools.count()
+
+
+class Replica:
+    """One engine + one worker thread pulling from the shared queue."""
+
+    def __init__(
+        self,
+        rid: int,
+        engine_factory,
+        queue: ClassQueue,
+        metrics: ServeMetrics,
+        *,
+        mode: str = "continuous",
+        max_wait_s: float = 0.002,
+        warm_buckets=None,
+        bus=None,
+        beat_every_s: float = BEAT_EVERY_S_DEFAULT,
+    ) -> None:
+        self.rid = int(rid)
+        self._engine_factory = engine_factory
+        self.engine = None  # built in the worker (replicas start in parallel)
+        self.queue = queue
+        self.metrics = metrics
+        self.mode = mode
+        self.max_wait_s = float(max_wait_s)
+        self.warm_buckets = warm_buckets
+        self.bus = bus
+        self.beat_every_s = float(beat_every_s)
+        self.state = STARTING
+        self.error: str | None = None
+        self.dispatches = 0
+        self.routed = 0  # requests this replica resolved
+        self.last_beat = time.monotonic()
+        self._last_beat_event = 0.0
+        self._lock = threading.Lock()
+        self._inflight: list = []
+        self._thread = threading.Thread(
+            target=self._run, name=f"serve-replica-{self.rid}", daemon=True
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "Replica":
+        self._thread.start()
+        return self
+
+    def _transition(self, state: str, **payload) -> None:
+        with self._lock:
+            if self.state in (STOPPED, DEAD) and state not in (STOPPED, DEAD):
+                return  # terminal states never revive
+            if self.state == DRAINING and state == READY:
+                return  # a drain issued during warmup sticks
+            self.state = state
+        if self.bus is not None:
+            self.bus.emit(
+                REPLICA_KIND, replica=self.rid, state=state, **payload
+            )
+
+    def _beat(self) -> None:
+        now = time.monotonic()
+        self.last_beat = now
+        if (
+            self.bus is not None
+            and now - self._last_beat_event >= self.beat_every_s
+        ):
+            self._last_beat_event = now
+            self.bus.emit(
+                REPLICA_KIND, replica=self.rid, state=self.state,
+                beat=True, dispatches=self.dispatches, routed=self.routed,
+                queue_depth=self.queue.depth,
+            )
+
+    def _run(self) -> None:
+        try:
+            if self.engine is None:
+                self.engine = self._engine_factory(self.rid)
+            self.engine.warmup(self.warm_buckets)
+        except Exception as e:  # a replica that can't start must say so
+            self.error = f"{type(e).__name__}: {e}"[:300]
+            self._transition(DEAD, error=self.error)
+            return
+        self._transition(
+            READY,
+            buckets=list(self.engine.buckets),
+            warmed=list(self.warm_buckets or self.engine.buckets),
+            persisted_hits=self.engine.stats().get("persisted_hits", 0),
+        )
+        while True:
+            with self._lock:
+                if self.state != READY:
+                    break
+            self._beat()
+            batch = self.queue.take(
+                self.engine.max_bucket,
+                window_s=self.max_wait_s,
+                continuous=self.mode == "continuous",
+                timeout_s=0.25,
+            )
+            if batch is None:  # queue closed and drained
+                break
+            if not batch:
+                continue
+            with self._lock:
+                if self.state == DEAD:
+                    # died between take and dispatch: these futures were
+                    # never registered in-flight, so fail them here —
+                    # requests must never hang on a retired replica
+                    doomed, batch = batch, []
+                else:
+                    # a DRAINING replica still dispatches the batch it
+                    # already admitted (drain = finish in-flight work);
+                    # the loop's state check exits afterwards
+                    doomed = []
+                    self._inflight = batch
+            for _, fut in doomed:
+                if fut.set_error(
+                    ReplicaDead(
+                        f"replica {self.rid} died with this request "
+                        "admitted but not dispatched"
+                    )
+                ):
+                    self.metrics.record_failed(fut.cls)
+            if not batch:
+                break
+            # beat NOW so the health timeout clocks this dispatch alone
+            # (take() may have blocked up to its own timeout first); a
+            # dispatch can legitimately hold the thread for a mid-serving
+            # bucket compile, which is why health_timeout_s must stay
+            # above the worst-case single dispatch INCLUDING a compile —
+            # see ServeRouter's docstring
+            self._beat()
+            dispatch_batch(self.engine, batch, self.metrics)
+            with self._lock:
+                self._inflight = []
+                self.dispatches += 1
+                self.routed += len(batch)
+            self._beat()
+        if self.state != DEAD:
+            self._transition(
+                STOPPED, dispatches=self.dispatches, routed=self.routed
+            )
+
+    # ----------------------------------------------------------- control
+
+    def drain(self) -> None:
+        """Stop pulling from the queue; the in-flight batch completes
+        (its futures resolve) and queued work flows to other replicas —
+        the preemption drain, zero lost requests."""
+        with self._lock:
+            if self.state not in (READY, STARTING):
+                return
+        # a STARTING replica drains by never going ready (the DRAINING
+        # state sticks through _transition's guard)
+        self._transition(DRAINING)
+
+    def mark_dead(self, why: str = "stale heartbeat") -> int:
+        """Declare this replica dead (health-check verdict): in-flight
+        futures fail typed; returns how many were failed.  The worker
+        thread, wherever it is stuck, exits at its next state check."""
+        with self._lock:
+            if self.state in (STOPPED, DEAD):
+                return 0
+            self.state = DEAD
+            inflight, self._inflight = self._inflight, []
+        failed = 0
+        for _, fut in inflight:
+            # set_error is atomic first-wins: a dispatch completing at
+            # this exact moment keeps its completion, and we count only
+            # the futures WE actually failed
+            if fut.set_error(
+                ReplicaDead(
+                    f"replica {self.rid} declared dead ({why}) with "
+                    "this request in flight"
+                )
+            ):
+                self.metrics.record_failed(fut.cls)
+                failed += 1
+        if self.bus is not None:
+            self.bus.emit(
+                REPLICA_KIND, replica=self.rid, state=DEAD, reason=why,
+                inflight_failed=failed,
+            )
+        return failed
+
+    def join(self, timeout: float = 30.0) -> None:
+        self._thread.join(timeout)
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "dispatches": self.dispatches,
+                "routed": self.routed,
+                "error": self.error,
+                "beat_age_s": round(time.monotonic() - self.last_beat, 3),
+            }
+
+
+class ServeRouter:
+    """Route requests across N replicas; health-check, drain, observe.
+
+    ``engine_factory(rid) -> engine`` builds one engine per replica
+    (called in the replica's own worker thread, so N replicas compile /
+    warm-start in parallel; share a ``PersistedServeCache`` and the
+    second replica deserializes what the first stored).  The router is
+    ``submit()``-compatible with ``MicroBatcher``, so every load
+    generator drives it unchanged.
+
+    ``health_timeout_s`` must exceed the worst-case SINGLE dispatch —
+    including a mid-serving bucket compile (a flash crowd on an unwarmed
+    bucket holds the worker in the engine for the whole compile; workers
+    beat right before each dispatch, so that compile is exactly what the
+    timeout clocks).  When every replica has died or stopped while the
+    queue is still open, the router GIVES UP rather than strand the
+    queue: queued futures fail typed (``ReplicaDead``), the queue closes
+    (subsequent submits raise ``BatcherClosed``), and a ``give_up``
+    ``serve_route`` event records it.
+    """
+
+    def __init__(
+        self,
+        engine_factory,
+        *,
+        replicas: int = 1,
+        classes: dict[str, SLOClass] | None = None,
+        mode: str = "continuous",
+        max_wait_ms: float = 2.0,
+        queue_limit: int = 256,
+        metrics: ServeMetrics | None = None,
+        bus=None,
+        registry=None,
+        warm_buckets=None,
+        health_timeout_s: float = HEALTH_TIMEOUT_S_DEFAULT,
+        emit_every_s: float = 5.0,
+        plan: dict | None = None,
+        start: bool = True,
+        monitor=None,
+    ) -> None:
+        if replicas < 1:
+            raise ValueError(f"router needs >= 1 replica, got {replicas}")
+        if mode not in ("continuous", "bucketed"):
+            raise ValueError(
+                f"mode must be 'continuous' or 'bucketed', got {mode!r}"
+            )
+        self.classes = dict(classes) if classes else default_classes()
+        self.metrics = metrics if metrics is not None else ServeMetrics(
+            registry=registry, classes=self.classes
+        )
+        self.queue = ClassQueue(
+            classes=self.classes, limit=queue_limit, metrics=self.metrics
+        )
+        self.bus = bus
+        self.registry = registry
+        self.mode = mode
+        self.plan = plan
+        # when given, the router ARMS the recompilation sentinel exactly
+        # once, after EVERY replica has finished (or failed) warmup —
+        # the engines are built arm_sentinel=False, so a fast replica
+        # can't turn its siblings' remaining warmup compiles into storm
+        self.monitor = monitor
+        self.seq = next(_ROUTER_SEQ)
+        self.health_timeout_s = float(health_timeout_s)
+        self.emit_every_s = float(emit_every_s)
+        self._engine_factory = engine_factory
+        self._closed = False
+        self.replicas = [
+            Replica(
+                rid, engine_factory, self.queue, self.metrics,
+                mode=mode, max_wait_s=float(max_wait_ms) / 1e3,
+                warm_buckets=warm_buckets, bus=bus,
+            )
+            for rid in range(int(replicas))
+        ]
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name="serve-router", daemon=True
+        )
+        if bus is not None:
+            payload = {
+                "state": "start",
+                "router": self.seq,
+                "replicas": len(self.replicas),
+                "mode": mode,
+                "classes": {
+                    name: slo.describe() for name, slo in self.classes.items()
+                },
+            }
+            if plan:
+                payload["plan"] = plan
+            bus.emit(ROUTE_KIND, **payload)
+        if start:
+            self.start()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "ServeRouter":
+        for r in self.replicas:
+            if not r._thread.is_alive() and r.state == STARTING:
+                r.start()
+        if not self._ticker.is_alive():
+            self._ticker.start()
+        return self
+
+    def wait_ready(self, timeout: float = 300.0, n: int = 1) -> bool:
+        """Block until ``n`` replicas are ready (warm).  False on
+        timeout or when every replica already failed."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            states = [r.state for r in self.replicas]
+            if sum(s == READY for s in states) >= n:
+                return True
+            if all(s in (DEAD, STOPPED) for s in states):
+                return False
+            time.sleep(0.02)
+        return False
+
+    def warmup(self, timeout: float = 600.0) -> None:
+        """Block until every replica has left ``starting`` (the serve
+        session's warmup barrier); raises if none became ready.  When
+        the router holds the compile monitor, the sentinel arms HERE —
+        after the whole fleet warmed — not per engine."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(r.state != STARTING for r in self.replicas):
+                break
+            time.sleep(0.05)
+        if not any(r.state == READY for r in self.replicas):
+            errors = [r.error for r in self.replicas if r.error]
+            raise RuntimeError(
+                f"no serve replica became ready: {errors or 'timeout'}"
+            )
+        if self.monitor is not None:
+            self.monitor.warm()
+
+    # ------------------------------------------------------------- serve
+
+    def submit(self, image, deadline_ms: float | None = None,
+               cls: str | None = None):
+        return self.queue.submit(image, deadline_ms=deadline_ms, cls=cls)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.queue.depth
+
+    def ready_replicas(self) -> list[Replica]:
+        return [r for r in self.replicas if r.state == READY]
+
+    # ------------------------------------------------------------ control
+
+    def drain(self, rid: int) -> None:
+        self.replicas[rid].drain()
+
+    def scale_up(self, n: int = 1, warm_buckets=None) -> list[int]:
+        """Add ``n`` fresh replicas (warm-starting from the shared
+        persisted cache when one is wired) — the router-side half of a
+        flash-crowd response."""
+        new_ids = []
+        for _ in range(int(n)):
+            rid = len(self.replicas)
+            r = Replica(
+                rid, self._engine_factory, self.queue, self.metrics,
+                mode=self.mode, max_wait_s=self.replicas[0].max_wait_s,
+                warm_buckets=(
+                    warm_buckets if warm_buckets is not None
+                    else self.replicas[0].warm_buckets
+                ),
+                bus=self.bus,
+            )
+            self.replicas.append(r)
+            r.start()
+            new_ids.append(rid)
+        return new_ids
+
+    def rewarm(self, buckets=None) -> dict:
+        """The ``rewarm_serve`` policy action, fleet-wide: every ready
+        replica re-runs ``warmup()`` on its affected bucket subset and
+        re-arms the sentinel.  Returns the per-replica report folded
+        into the ``policy`` event's ``completed`` payload."""
+        out = {}
+        for r in self.ready_replicas():
+            try:
+                out[str(r.rid)] = r.engine.rewarm(buckets)
+            except Exception as e:  # one replica's failure isn't the fleet's
+                out[str(r.rid)] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        return {"replicas": out}
+
+    def health_check(self) -> list[int]:
+        """Declare replicas with stale heartbeats dead; returns their
+        ids.  Called by the ticker; callable directly in tests."""
+        now = time.monotonic()
+        dead = []
+        for r in self.replicas:
+            if (
+                r.state == READY
+                and now - r.last_beat > self.health_timeout_s
+            ):
+                r.mark_dead(
+                    f"no heartbeat for {now - r.last_beat:.1f}s "
+                    f"(timeout {self.health_timeout_s:g}s)"
+                )
+                dead.append(r.rid)
+        self._maybe_give_up()
+        return dead
+
+    def _maybe_give_up(self) -> None:
+        """Every replica dead/stopped while the queue is still open:
+        nothing will ever pull again, so fail the queued futures typed
+        and close the door — a request must never hang on a fleet that
+        has no one left to serve it (``ClassQueue.fail_all``'s reason to
+        exist).  Normal ``close()`` never takes this path: there the
+        queue closes FIRST and the replicas stop by draining it."""
+        if self.queue.closed:
+            return
+        states = [r.state for r in self.replicas]
+        if not states or not all(s in (DEAD, STOPPED) for s in states):
+            return
+        failed = self.queue.fail_all(
+            ReplicaDead(
+                "every serve replica is dead or stopped "
+                f"(states {states}); queued request abandoned"
+            )
+        )
+        self.queue.close(drain=False)
+        if self.bus is not None:
+            self.bus.emit(
+                ROUTE_KIND, state="give_up", router=self.seq,
+                queued_failed=failed,
+                replicas={str(r.rid): r.state for r in self.replicas},
+            )
+
+    # --------------------------------------------------------------- obs
+
+    def _tick_loop(self) -> None:
+        last_emit = time.monotonic()
+        while not self._closed:
+            time.sleep(min(0.25, self.emit_every_s))
+            self.health_check()
+            now = time.monotonic()
+            if now - last_emit >= self.emit_every_s:
+                last_emit = now
+                self.emit_route_event()
+                if self.registry is not None and self.bus is not None:
+                    # the live feed of compile/* counters + per-class
+                    # latency series for in-process --alert rules (the
+                    # recompile-storm sentinel fires mid-session, not at
+                    # the closing flush)
+                    self.registry.flush(self.bus)
+
+    def emit_route_event(self, final: bool = False) -> dict | None:
+        if self.bus is None:
+            return None
+        payload = {
+            "state": "final" if final else "routing",
+            "router": self.seq,
+            "queue_depth": self.queue.depth,
+            "replicas": {
+                str(r.rid): r.describe() for r in self.replicas
+            },
+            "classes": self.metrics.class_payload(),
+            "completed": self.metrics.completed,
+            "shed": self.metrics.shed,
+            "expired": self.metrics.expired,
+            "failed": self.metrics.failed,
+        }
+        return self.bus.emit(ROUTE_KIND, **payload)
+
+    def stats(self) -> dict:
+        out = {
+            "replicas": {str(r.rid): r.describe() for r in self.replicas},
+            "queue_depth": self.queue.depth,
+            "mode": self.mode,
+        }
+        # fold the per-replica engine counters (every replica that built
+        # an engine, whatever its current state — a closed router's
+        # stats must still report the session's engine counters)
+        engines = [
+            r.engine.stats() for r in self.replicas if r.engine is not None
+        ]
+        if engines:
+            out["engine"] = {
+                "buckets": engines[0]["buckets"],
+                "compiles": sum(e["compiles"] for e in engines),
+                "cache_hits": sum(e["cache_hits"] for e in engines),
+                "persisted_hits": sum(
+                    e.get("persisted_hits", 0) for e in engines
+                ),
+                "bucket_counts": {
+                    b: sum(e["bucket_counts"].get(b, 0) for e in engines)
+                    for b in engines[0]["bucket_counts"]
+                },
+            }
+        if self.plan:
+            out["plan"] = self.plan
+        return out
+
+    # -------------------------------------------------------------- close
+
+    def close(self, drain: bool = True, timeout: float = 60.0) -> None:
+        self.queue.close(drain=drain)
+        for r in self.replicas:
+            r.join(timeout)
+        self._closed = True
+        self.emit_route_event(final=True)
+
+    def __enter__(self) -> "ServeRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ----------------------------------------------------- ledger-fit sizing
+
+
+def serve_exec_flops(events) -> dict[int, float]:
+    """Per-bucket whole-program FLOPs of the serve executables in a
+    merged event stream (compile events named ``serve_predict@b{N}``),
+    normalized per device."""
+    out: dict[int, float] = {}
+    for ev in events or ():
+        if not isinstance(ev, dict) or ev.get("kind") != "compile":
+            continue
+        p = ev.get("payload") or {}
+        name = str(p.get("name", ""))
+        if not name.startswith("serve_predict@b"):
+            continue
+        try:
+            bucket = int(name.rsplit("@b", 1)[1])
+        except ValueError:
+            continue
+        flops = p.get("flops")
+        if flops:
+            out[bucket] = float(flops) / max(1, int(p.get("devices") or 1))
+    return out
+
+
+def plan_serve(
+    events,
+    *,
+    buckets,
+    rate_rps: float = 0.0,
+    classes: dict[str, SLOClass] | None = None,
+    device_kind: str | None = None,
+    max_replicas: int = 8,
+    utilization: float = PLAN_UTILIZATION,
+) -> dict:
+    """Score replica count and the bucket ladder with the auto-parallel
+    planner's ledger-fit cost model (``parallel/planner.py``).
+
+    Per bucket: ``service_s = secs_per_flop × flops(b)/device +
+    overhead_s`` (flops from the committed serve compile events; the
+    slope/overhead regressed from the ledger's dispatch sketches, with
+    the same peak-table/default fallbacks, recorded as ``fit.source``).
+    Replica count: offered ``rate_rps`` ÷ (per-replica capacity at the
+    best bucket × ``utilization``), clamped to ``[1, max_replicas]``.
+    Ladder: buckets whose service time alone fits the tightest class
+    deadline (all, when no class declares one).  Every term lands in the
+    returned dict — the plan is explainable from its own payload, and
+    rides the router's opening ``serve_route`` event.
+    """
+    from ..parallel import planner as planner_mod
+
+    ledger = planner_mod.fit_ledger(events)
+    cost = planner_mod.CostModel.fit(
+        ledger, device_kind or ledger.device_kind
+    )
+    flops_by_bucket = serve_exec_flops(events)
+    per_bucket: dict = {}
+    for b in sorted(int(x) for x in buckets):
+        f = flops_by_bucket.get(b)
+        if f is None and flops_by_bucket:
+            # scale from the nearest captured bucket (flops ~ linear in b)
+            ref_b, ref_f = min(
+                flops_by_bucket.items(), key=lambda kv: abs(kv[0] - b)
+            )
+            f = ref_f * b / ref_b
+        if f is None:
+            continue
+        service_s = cost.secs_per_flop * f + cost.overhead_s
+        rps = b / service_s if service_s > 0 else 0.0
+        per_bucket[str(b)] = {
+            "flops_per_device": f,
+            "service_s": service_s,
+            "rps": rps,
+        }
+    deadlines = [
+        slo.deadline_ms for slo in (classes or {}).values()
+        if slo.deadline_ms is not None
+    ]
+    tightest_ms = min(deadlines) if deadlines else None
+    if tightest_ms is not None and per_bucket:
+        ladder = [
+            int(b) for b, row in per_bucket.items()
+            if row["service_s"] * 1e3 <= tightest_ms
+        ]
+        # an empty ladder would refuse all traffic; keep the smallest
+        # bucket and let the attainment gate surface the infeasibility
+        ladder = sorted(ladder) or [min(int(b) for b in per_bucket)]
+    else:
+        ladder = sorted(int(b) for b in buckets)
+    # capacity comes from the best bucket ON THE LADDER the replicas
+    # will actually serve: sizing from a deadline-trimmed-out bucket's
+    # throughput would undersize the fleet for the ladder it carries
+    best_rps = 0.0
+    best_bucket = None
+    for b in ladder:
+        row = per_bucket.get(str(b))
+        if row is not None and row["rps"] > best_rps:
+            best_rps, best_bucket = row["rps"], b
+    if rate_rps > 0 and best_rps > 0:
+        replicas = max(
+            1, min(int(max_replicas),
+                   math.ceil(rate_rps / (utilization * best_rps)))
+        )
+        sized_by = "ledger"
+    else:
+        replicas = 1
+        sized_by = "no-rate" if best_rps > 0 else "no-serve-ledger"
+    return {
+        "replicas": replicas,
+        "buckets": ladder,
+        "sized_by": sized_by,
+        "offered_rps": float(rate_rps),
+        "per_replica_capacity_rps": best_rps,
+        "best_bucket": best_bucket,
+        "utilization_target": float(utilization),
+        "tightest_deadline_ms": tightest_ms,
+        "per_bucket": per_bucket,
+        "fit": cost.describe(),
+    }

@@ -8,7 +8,11 @@ from .seed import fix_seed
 from .meters import AverageMeter, StepTimeMeter
 from .metrics import accuracy, topk_correct
 from .logging import setup_logger
-from .compile_cache import enable_persistent_compilation_cache
+from .compile_cache import (
+    DonatedExecutableError,
+    PersistedServeCache,
+    enable_persistent_compilation_cache,
+)
 
 __all__ = [
     "fix_seed",
@@ -18,4 +22,6 @@ __all__ = [
     "topk_correct",
     "setup_logger",
     "enable_persistent_compilation_cache",
+    "PersistedServeCache",
+    "DonatedExecutableError",
 ]

@@ -1,0 +1,80 @@
+"""Cold-start child for tests/test_serve_fleet.py: one REAL fresh
+serving process against a shared persisted AOT store.
+
+Builds a TinyNet serve engine (mirroring ``tests/test_train.TinyNet`` —
+defined inline so importing this worker never imports a test module)
+with a CompileMonitor bound to ``events_dir`` and a
+``PersistedServeCache`` at ``aot_dir``, warms the ladder, serves one
+smoke batch, and prints one JSON line with the engine counters.  The
+parent judges the STREAM (compile events in ``events_dir``), not this
+self-report: the first child must pay real compiles and store, the
+second must deserialize by fingerprint and compile nothing.
+
+Usage: ``python tests/serve_cold_worker.py EVENTS_DIR AOT_DIR``
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(events_dir: str, aot_dir: str) -> None:
+    import flax.linen as lnn
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_training_comparison_tpu import obs
+    from distributed_training_comparison_tpu.serve import ServeEngine
+    from distributed_training_comparison_tpu.utils import PersistedServeCache
+
+    class TinyNet(lnn.Module):
+        num_classes: int = 10
+        dtype: jnp.dtype = jnp.float32
+
+        @lnn.compact
+        def __call__(self, x, train: bool = False):
+            x = x.astype(self.dtype)
+            x = lnn.Conv(
+                8, (3, 3), strides=2, use_bias=False, dtype=self.dtype
+            )(x)
+            x = lnn.BatchNorm(
+                use_running_average=not train, dtype=self.dtype
+            )(x)
+            x = lnn.relu(x)
+            x = jnp.mean(x, axis=(1, 2))
+            return lnn.Dense(
+                self.num_classes, dtype=self.dtype
+            )(x).astype(jnp.float32)
+
+    bus = obs.configure(run_id=obs.new_run_id())
+    bus.bind_dir(events_dir)
+    registry = obs.MetricRegistry()
+    monitor = obs.CompileMonitor(bus=bus, registry=registry)
+    t0 = time.perf_counter()
+    engine = ServeEngine(
+        model=TinyNet(),
+        buckets=(2, 4),
+        precision="fp32",
+        image_size=16,
+        monitor=monitor,
+        aot_cache=PersistedServeCache(aot_dir),
+    )
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    engine.predict_logits(np.zeros((3, 16, 16, 3), np.uint8))
+    registry.flush(bus)
+    stats = engine.stats()
+    print(json.dumps({
+        "warmup_s": round(warmup_s, 3),
+        "compiles": stats["compiles"],
+        "persisted_hits": stats["persisted_hits"],
+        "aot_cache": stats["aot_cache"],
+    }))
+    obs.reset(bus)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])

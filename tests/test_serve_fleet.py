@@ -1,0 +1,778 @@
+"""Serving-fleet tests: SLO classes, continuous batching, the router,
+and the persisted AOT warm-start (tier-1 fast).
+
+The contracts pinned here, each matching a production claim the README
+makes:
+
+- **SLO classes** (`batcher.ClassQueue`): priority-ordered dispatch, the
+  class-aware shed decision (a full queue evicts the least important
+  queued work for a more important newcomer), deadline expiry enforced
+  at TAKE time — an expired request never burns a bucket slot and bumps
+  the ``serve/shed_total`` counter.
+- **Continuous admission**: a lone queued request dispatches at the next
+  step boundary, not after the bucketed window.
+- **Router** (`serve/router.py`): drain-on-preempt completes in-flight
+  futures and re-routes queued work with zero lost requests; a replica
+  declared dead fails its in-flight futures typed (``ReplicaDead``) and
+  the survivors absorb the queue; ``rewarm_serve`` reaches every ready
+  replica.
+- **Persisted AOT warm-start** (`utils.PersistedServeCache`): a fresh
+  engine — and a REAL fresh process — finds the first process's
+  executables by the CompileMonitor's cross-process fingerprint and
+  compiles nothing (every compile event in its stream carries
+  ``cache: "persisted"``); donated executables are refused at the store
+  site (the ``_compat.donated_cache_write_barred`` jax-pin bug), and a
+  torn blob degrades to a recompile, never a wedge.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import run_report  # noqa: E402
+
+from distributed_training_comparison_tpu import obs
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.obs.exporter import (
+    render_openmetrics,
+    split_labels,
+)
+from distributed_training_comparison_tpu.ops.policy import serve_actions
+from distributed_training_comparison_tpu.resilience.faults import (
+    CHAOS_SCENARIOS,
+    check_chaos_expectations,
+)
+from distributed_training_comparison_tpu.serve import (
+    ClassQueue,
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+    ReplicaDead,
+    ServeEngine,
+    ServeMetrics,
+    ServeRouter,
+    SLOClassError,
+    parse_slo_classes,
+    plan_serve,
+)
+from distributed_training_comparison_tpu.serve.router import (
+    DEAD,
+    READY,
+    STOPPED,
+)
+from distributed_training_comparison_tpu.utils import (
+    DonatedExecutableError,
+    PersistedServeCache,
+)
+
+from test_train import TinyNet
+
+IMG = 16
+
+
+def _img():
+    return np.zeros((4, 4, 3), np.uint8)
+
+
+def _wait(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------------ SLO classes
+
+
+def test_parse_slo_classes_grammar():
+    table = parse_slo_classes(
+        "gold:priority=0:deadline_ms=250:target=0.99,batch:priority=2"
+    )
+    assert table["gold"].priority == 0
+    assert table["gold"].deadline_ms == 250.0
+    assert table["gold"].target == 0.99
+    assert table["batch"].priority == 2 and table["batch"].deadline_ms is None
+    # class-less submit() keeps working: a default class is appended
+    assert "default" in table
+    assert set(parse_slo_classes("")) == {"default"}
+
+
+@pytest.mark.parametrize(
+    "spec",
+    [
+        "gold:badfield=1",          # unknown field
+        "gold:priority=x",          # not a number
+        "gold:target=1.5",          # target out of [0, 1]
+        "gold:deadline_ms=0",       # deadline must be > 0
+        "gold:priority=0,gold:priority=1",  # duplicate class
+    ],
+)
+def test_parse_slo_classes_rejects(spec):
+    with pytest.raises(SLOClassError):
+        parse_slo_classes(spec)
+
+
+def test_class_queue_priority_orders_dispatch():
+    classes = parse_slo_classes("gold:priority=0,bulk:priority=2")
+    q = ClassQueue(classes=classes, limit=16)
+    for _ in range(3):
+        q.submit(_img(), cls="bulk")
+    gold = q.submit(_img(), cls="gold")
+    batch = q.take(2, continuous=True)
+    # the gold request queued LAST dispatches FIRST
+    assert batch[0][1] is gold
+    assert batch[1][1].cls == "bulk"
+    q.close(drain=False)
+
+
+def test_class_queue_sheds_least_important_for_newcomer():
+    classes = parse_slo_classes("gold:priority=0,bulk:priority=2")
+    m = ServeMetrics()
+    q = ClassQueue(classes=classes, limit=2, metrics=m)
+    q.submit(_img(), cls="bulk")
+    victim = q.submit(_img(), cls="bulk")
+    gold = q.submit(_img(), cls="gold")  # full queue: evicts newest bulk
+    with pytest.raises(QueueOverflow):
+        victim.result(timeout=1)
+    assert not gold.done()
+    # a newcomer nothing outranks is shed synchronously instead
+    with pytest.raises(QueueOverflow):
+        q.submit(_img(), cls="bulk")
+    assert m.shed == 2  # the evicted victim + the refused newcomer
+    assert q.depth == 2
+    q.close(drain=False)
+
+
+def test_future_resolution_is_atomic_first_wins():
+    from distributed_training_comparison_tpu.serve import ServeFuture
+
+    fut = ServeFuture(time.monotonic(), None)
+    assert fut.set_error(ReplicaDead("first")) is True
+    assert fut.set_result(np.zeros(4)) is False  # loser: must not record
+    with pytest.raises(ReplicaDead):
+        fut.result(timeout=1)
+    fut2 = ServeFuture(time.monotonic(), None)
+    assert fut2.set_result(np.ones(2)) is True
+    assert fut2.set_error(ReplicaDead("late")) is False
+    assert (fut2.result(timeout=1) == 1).all()
+
+
+def test_unknown_class_is_typed():
+    q = ClassQueue(limit=4)
+    with pytest.raises(SLOClassError):
+        q.submit(_img(), cls="nonexistent")
+    q.close(drain=False)
+
+
+# ------------------------------- satellite: expiry at take, never after
+
+
+class _StubEngine:
+    """Engine stand-in with a controllable service time."""
+
+    max_bucket = 8
+    buckets = (8,)
+
+    def __init__(self, delay_s=0.0, rid=0):
+        self.delay_s = delay_s
+        self.rid = rid
+        self.calls = []
+        self.rewarms = 0
+
+    def warmup(self, buckets=None):
+        return None
+
+    def rewarm(self, buckets=None):
+        self.rewarms += 1
+        return {"warmed": list(buckets or self.buckets)}
+
+    def stats(self):
+        return {
+            "buckets": list(self.buckets), "compiles": 0, "cache_hits": 0,
+            "persisted_hits": 0, "bucket_counts": {8: 0},
+        }
+
+    def predict_logits(self, imgs):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.calls.append(len(imgs))
+        return np.zeros((len(imgs), 4), np.float32)
+
+
+def test_expired_request_never_burns_a_slot_and_counts_as_shed():
+    reg = obs.MetricRegistry()
+    m = ServeMetrics(registry=reg)
+    q = ClassQueue(limit=32, metrics=m)
+    doomed = q.submit(_img(), deadline_ms=1.0)
+    live = [q.submit(_img()) for _ in range(8)]
+    time.sleep(0.05)  # the deadline lapses while queued
+    batch = q.take(8, continuous=True)
+    # the expired request was failed at take time and did NOT displace
+    # any of the 8 live requests from the full bucket
+    assert len(batch) == 8
+    assert all(f in [fut for _, fut in batch] for f in live)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=1)
+    assert m.expired == 1
+    st = m.class_payload()["default"]
+    assert st["expired_pre_dispatch"] == 1
+    # the satellite's counter: wasted admission is shed, whatever the type
+    assert reg.counter("serve/shed_total").snapshot(reset=False)["n"] == 1
+    q.close(drain=False)
+
+
+def test_shed_total_also_counts_queue_overflow():
+    reg = obs.MetricRegistry()
+    m = ServeMetrics(registry=reg)
+    q = ClassQueue(limit=1, metrics=m)
+    q.submit(_img())
+    with pytest.raises(QueueOverflow):
+        q.submit(_img())
+    assert reg.counter("serve/shed_total").snapshot(reset=False)["n"] == 1
+    q.close(drain=False)
+
+
+def test_bucketed_window_rechecks_deadlines_before_dispatch():
+    """A deadline that lapses DURING the coalescing window must fail
+    pre-dispatch — the windowed path admitted it live, then out-waited
+    it; it must not reach the engine as a doomed 'completed' request."""
+    reg = obs.MetricRegistry()
+    m = ServeMetrics(registry=reg)
+    q = ClassQueue(limit=8, metrics=m)
+    doomed = q.submit(_img(), deadline_ms=50.0)  # alive now, dead in 50ms
+    batch = q.take(8, window_s=0.2, continuous=False)  # window > deadline
+    assert batch == []  # nothing for the engine
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=1)
+    st = m.class_payload()["default"]
+    assert st["expired"] == 1 and st["expired_pre_dispatch"] == 1
+    q.close(drain=False)
+
+
+def test_continuous_admission_skips_the_window():
+    # bucketed: a lone request waits out the coalescing window
+    q = ClassQueue(limit=8)
+    q.submit(_img())
+    t0 = time.monotonic()
+    batch = q.take(8, window_s=0.25, continuous=False)
+    assert len(batch) == 1 and time.monotonic() - t0 >= 0.2
+    q.close(drain=False)
+    # continuous: the same lone request dispatches at the step boundary
+    q2 = ClassQueue(limit=8)
+    q2.submit(_img())
+    t0 = time.monotonic()
+    batch = q2.take(8, window_s=0.25, continuous=True)
+    assert len(batch) == 1 and time.monotonic() - t0 < 0.2
+    q2.close(drain=False)
+
+
+def test_micro_batcher_continuous_mode_end_to_end():
+    eng = _StubEngine(delay_s=0.02)
+    with MicroBatcher(
+        eng, max_wait_ms=10_000, queue_limit=64, mode="continuous"
+    ) as b:
+        futs = [b.submit(_img()) for _ in range(12)]
+        rows = [f.result(timeout=5) for f in futs]
+    # a 10-second window never gated anything: the first dispatch went
+    # out immediately and later dispatches slot-filled what had queued
+    assert len(rows) == 12
+    assert sum(eng.calls) == 12
+    with pytest.raises(ValueError):
+        MicroBatcher(eng, mode="nonsense")
+
+
+# ------------------------------------------------------------- the router
+
+
+def _bus(tmp_path):
+    bus = obs.EventBus(run_id="f" * 16)
+    bus.bind_dir(tmp_path)
+    return bus
+
+
+def test_router_drain_on_preempt_loses_nothing(tmp_path):
+    """The preemption drain: in-flight futures complete, queued work
+    re-routes to the surviving replica, zero lost requests."""
+    stubs = {}
+
+    def factory(rid):
+        stubs[rid] = _StubEngine(delay_s=0.08, rid=rid)
+        return stubs[rid]
+
+    bus = _bus(tmp_path)
+    r = ServeRouter(factory, replicas=2, bus=bus, queue_limit=256,
+                    emit_every_s=0.2)
+    try:
+        r.warmup()
+        futs = [r.submit(_img()) for _ in range(80)]
+        _wait(lambda: r.replicas[0].dispatches >= 1, what="first dispatch")
+        r.drain(0)
+        rows = [f.result(timeout=30) for f in futs]  # raises on any loss
+        assert len(rows) == 80
+        _wait(lambda: r.replicas[0].state == STOPPED,
+              what="drained replica to stop")
+        assert r.replicas[1].state == READY
+        assert r.replicas[1].routed > 0  # the queue re-routed
+        assert r.replicas[0].routed + r.replicas[1].routed == 80
+    finally:
+        r.close()
+    states = [
+        (e["payload"]["replica"], e["payload"]["state"])
+        for e in obs.load_events(Path(tmp_path) / "events.jsonl")
+        if e["kind"] == "replica" and "state" in e.get("payload", {})
+    ]
+    assert (0, "draining") in states and (0, "stopped") in states
+
+
+def test_router_dead_replica_fails_inflight_typed_and_queue_survives():
+    def factory(rid):
+        if rid == 1:
+            # replica 1 is slow to warm: replica 0 owns the early traffic
+            class _Slow(_StubEngine):
+                def warmup(self, buckets=None):
+                    time.sleep(0.6)
+            return _Slow(delay_s=0.05, rid=rid)
+        return _StubEngine(delay_s=0.5, rid=rid)
+
+    r = ServeRouter(factory, replicas=2, queue_limit=64)
+    try:
+        r.wait_ready(n=1, timeout=10)
+        futs = [r.submit(_img()) for _ in range(9)]
+        _wait(lambda: r.replicas[0]._inflight, what="in-flight batch")
+        failed = r.replicas[0].mark_dead("test verdict")
+        assert failed >= 1
+        assert r.replicas[0].state == DEAD
+        outcomes = {"dead": 0, "ok": 0}
+        for f in futs:
+            try:
+                f.result(timeout=30)
+                outcomes["ok"] += 1
+            except ReplicaDead:
+                outcomes["dead"] += 1
+        # exactly the in-flight futures failed typed; everything queued
+        # (never pinned to the dead replica) completed on the survivor
+        assert outcomes["dead"] == failed
+        assert outcomes["ok"] == 9 - failed
+    finally:
+        r.close()
+
+
+def test_router_gives_up_when_the_whole_fleet_is_gone(tmp_path):
+    """Every replica dead while the queue is open: queued futures fail
+    typed instead of hanging, the door closes, give_up hits the stream."""
+    bus = _bus(tmp_path)
+    r = ServeRouter(
+        lambda rid: _StubEngine(delay_s=0.5), replicas=1, bus=bus,
+        queue_limit=64,
+    )
+    try:
+        r.warmup()
+        futs = [r.submit(_img()) for _ in range(20)]  # 8 in flight, 12 queued
+        _wait(lambda: r.replicas[0]._inflight, what="in-flight batch")
+        r.replicas[0].mark_dead("test verdict")
+        r.health_check()  # the ticker's give-up pass, run directly
+        for f in futs:
+            with pytest.raises(ReplicaDead):
+                f.result(timeout=10)  # nothing may hang
+        from distributed_training_comparison_tpu.serve import BatcherClosed
+
+        with pytest.raises(BatcherClosed):
+            r.submit(_img())
+    finally:
+        r.close()
+    give_ups = [
+        e["payload"] for e in obs.load_events(Path(tmp_path) / "events.jsonl")
+        if e["kind"] == "serve_route" and e["payload"].get("state") == "give_up"
+    ]
+    assert len(give_ups) == 1
+    assert give_ups[0]["queued_failed"] > 0
+    # every abandoned request is a terminal per-class failure
+    assert r.metrics.failed == 20
+
+
+def test_dead_replica_dispatch_does_not_double_count():
+    """mark_dead fails the in-flight futures; when the still-running
+    dispatch later produces their results, it must NOT also record them
+    completed (the attainment gate would count each request twice)."""
+    r = ServeRouter(
+        lambda rid: _StubEngine(delay_s=0.4), replicas=1, queue_limit=16,
+    )
+    try:
+        r.warmup()
+        futs = [r.submit(_img()) for _ in range(4)]
+        _wait(lambda: r.replicas[0]._inflight, what="in-flight batch")
+        failed = r.replicas[0].mark_dead("test verdict")
+        assert failed >= 1
+        for f in futs:
+            with pytest.raises(ReplicaDead):
+                f.result(timeout=10)
+        time.sleep(0.6)  # let the doomed dispatch finish
+        assert r.metrics.completed == 0
+        assert all(
+            row["completed"] == 0 and row["ok_deadline"] == 0
+            for row in r.metrics.class_payload().values()
+        )
+        # the failures LAND in the SLO denominator: attainment reads
+        # 0.0, not "all targets met over vanished traffic"
+        row = r.metrics.class_payload()["default"]
+        assert row["failed"] == 4
+        assert row["attainment"] == 0.0
+    finally:
+        r.close()
+
+
+def test_router_rewarm_reaches_every_ready_replica():
+    stubs = {}
+
+    def factory(rid):
+        stubs[rid] = _StubEngine(rid=rid)
+        return stubs[rid]
+
+    r = ServeRouter(factory, replicas=2, queue_limit=16)
+    try:
+        r.warmup()
+        report = serve_actions(r)["rewarm_serve"]({})
+        assert set(report["replicas"]) == {"0", "1"}
+        assert all(s.rewarms == 1 for s in stubs.values())
+    finally:
+        r.close()
+
+
+def test_router_arms_sentinel_after_fleet_warmup_not_per_engine():
+    """N replicas warm one shared monitor in parallel: the first
+    finisher must not arm the sentinel while its siblings are still
+    paying genuine warmup compiles — the router arms once, after the
+    whole fleet warmed."""
+    monitor = obs.CompileMonitor(
+        bus=obs.EventBus(run_id="e" * 16), registry=obs.MetricRegistry()
+    )
+    eng = ServeEngine(
+        model=TinyNet(num_classes=10), buckets=(2,), precision="fp32",
+        image_size=IMG, monitor=monitor, arm_sentinel=False,
+    )
+    eng.warmup()
+    assert not monitor.is_warm  # deferred: the engine did NOT arm it
+    r = ServeRouter(
+        lambda rid: _StubEngine(rid=rid), replicas=2, monitor=monitor,
+        queue_limit=8,
+    )
+    try:
+        r.warmup()
+        assert monitor.is_warm  # the router armed it at the barrier
+    finally:
+        r.close()
+
+
+def test_serve_class_table_sums_across_routers(tmp_path):
+    """Two sequential routers in one process (distinct `router` tokens):
+    their cumulative counters SUM instead of the last one winning."""
+    bus = obs.EventBus(run_id="f" * 16)
+    bus.bind_dir(tmp_path)
+    row = {"completed": 3, "ok_deadline": 3, "expired": 0, "shed": 0,
+           "failed": 0, "priority": 0, "deadline_ms": 50.0, "target": 0.5}
+    bus.emit("serve_route", state="routing", router=0,
+             classes={"gold": dict(row)})
+    bus.emit("serve_route", state="final", router=1,
+             classes={"gold": dict(row, completed=7, ok_deadline=6,
+                                   failed=1)})
+    table = run_report.serve_class_table(
+        obs.load_events(Path(tmp_path) / "events.jsonl")
+    )
+    assert table["gold"]["completed"] == 10  # 3 + 7, not last-wins 7
+    assert table["gold"]["ok_deadline"] == 9
+    assert table["gold"]["failed"] == 1
+    # failures sit in the denominator: 9 ok of 11 terminal
+    assert abs(table["gold"]["attainment"] - 9 / 11) < 1e-9
+
+
+def test_router_validates_flags():
+    with pytest.raises(ValueError):
+        ServeRouter(lambda rid: _StubEngine(), replicas=0)
+    with pytest.raises(ValueError):
+        ServeRouter(lambda rid: _StubEngine(), replicas=1, mode="nope")
+
+
+# -------------------------------------------- persisted AOT warm-start
+
+
+@pytest.fixture
+def private_jax_cache(tmp_path):
+    """A fresh, empty jax HLO cache for the duration of one test: the
+    warm-start contract needs the first engine's build to be a REAL
+    compile (an executable materialized from a warm HLO cache serializes
+    into a blob whose fusion symbols are missing on this jaxlib — the
+    store-time round-trip verify refuses it, by design)."""
+    import jax
+
+    old = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path / "jax"))
+    yield
+    jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_persisted_cache_warm_starts_fresh_engine_by_fingerprint(
+    tmp_path, private_jax_cache
+):
+    aot = PersistedServeCache(tmp_path / "aot")
+    bus1 = obs.EventBus(run_id="a" * 16)
+    bus1.bind_dir(tmp_path / "p1")
+    reg1 = obs.MetricRegistry()
+    e1 = ServeEngine(
+        model=TinyNet(num_classes=10), buckets=(2, 4), precision="fp32",
+        image_size=IMG, monitor=obs.CompileMonitor(bus=bus1, registry=reg1),
+        aot_cache=aot,
+    )
+    e1.warmup()
+    assert e1.stats()["compiles"] == 2
+    assert aot.stats()["stores"] == 2 and aot.stats()["rejected"] == 0
+
+    # a FRESH engine + monitor against the same store: zero compiles,
+    # every ladder entry deserialized by fingerprint, and the stream
+    # carries only `cache: "persisted"` compile events
+    bus2 = obs.EventBus(run_id="b" * 16)
+    bus2.bind_dir(tmp_path / "p2")
+    reg2 = obs.MetricRegistry()
+    e2 = ServeEngine(
+        model=TinyNet(num_classes=10), buckets=(2, 4), precision="fp32",
+        image_size=IMG, monitor=obs.CompileMonitor(bus=bus2, registry=reg2),
+        aot_cache=PersistedServeCache(tmp_path / "aot"),
+    )
+    e2.warmup()
+    assert e2.stats()["compiles"] == 0
+    assert e2.stats()["persisted_hits"] == 2
+    evs = obs.load_events(tmp_path / "p2" / "events.jsonl")
+    comp = [e["payload"] for e in evs if e["kind"] == "compile"]
+    assert len(comp) == 2
+    assert all(p["cache"] == "persisted" for p in comp)
+    # a millisecond deserialization must never page the recompile
+    # sentinel (rewarm_serve exists for real compile cliffs)
+    assert not any(p.get("recompile_after_warmup") for p in comp)
+    # the cross-process join: the SAME fingerprints, either side
+    fps1 = {
+        e["payload"]["fingerprint"]
+        for e in obs.load_events(tmp_path / "p1" / "events.jsonl")
+        if e["kind"] == "compile"
+    }
+    assert {p["fingerprint"] for p in comp} == fps1
+    # and the warm-started engine still computes
+    out = e2.predict_logits(np.zeros((3, IMG, IMG, 3), np.uint8))
+    assert out.shape == (3, 10)
+
+
+def test_cold_start_real_fresh_process_hits_cache_by_fingerprint(tmp_path):
+    """The bench leg's contract at test size: two REAL fresh processes
+    against one persisted store — the first pays real compiles and
+    stores, the restarted one compiles NOTHING (stream-judged)."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "jax"),
+    )
+    worker = Path(__file__).parent / "serve_cold_worker.py"
+    reports = {}
+    for tag in ("cold", "warm"):
+        proc = subprocess.run(
+            [sys.executable, str(worker),
+             str(tmp_path / tag), str(tmp_path / "aot")],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        reports[tag] = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert reports["cold"]["compiles"] == 2
+    assert reports["cold"]["aot_cache"]["stores"] == 2
+    assert reports["warm"]["compiles"] == 0
+    assert reports["warm"]["persisted_hits"] == 2
+    caches = {}
+    for tag in ("cold", "warm"):
+        caches[tag] = [
+            (e["payload"]["fingerprint"], e["payload"]["cache"])
+            for e in obs.load_events(tmp_path / tag / "events.jsonl")
+            if e["kind"] == "compile"
+        ]
+    # judge the stream, not the self-report: the restarted process's
+    # compile events are ALL persisted loads, under the same fingerprints
+    assert all(c == "persisted" for _, c in caches["warm"])
+    assert {f for f, _ in caches["warm"]} == {f for f, _ in caches["cold"]}
+    assert all(c != "persisted" for _, c in caches["cold"])
+
+
+def test_store_refuses_donated_executables(tmp_path):
+    cache = PersistedServeCache(tmp_path)
+    with pytest.raises(DonatedExecutableError) as ei:
+        cache.store("deadbeef00000000", object(), donated=(1,))
+    # the refusal names the jax-pin bug it guards against
+    assert "donated_cache_write_barred" in str(ei.value)
+    assert not list(Path(tmp_path).glob("*.aotexe"))
+
+
+def test_torn_blob_degrades_to_recompile_and_unlinks(tmp_path):
+    cache = PersistedServeCache(tmp_path)
+    path = cache.path_for("feedface00000000")
+    path.write_bytes(b"not a pickled executable")
+    exe, load_s = cache.load("feedface00000000")
+    assert exe is None
+    assert cache.errors == 1
+    assert not path.exists()  # poisoned entries must not wedge cold starts
+
+
+# ----------------------------------------------------- ledger-fit sizing
+
+
+def _serve_compile_ev(bucket, flops):
+    return {
+        "kind": "compile",
+        "payload": {
+            "name": f"serve_predict@b{bucket}", "flops": flops,
+            "devices": 1, "fingerprint": "ab" * 8,
+        },
+    }
+
+
+def test_plan_serve_sizes_replicas_and_trims_ladder():
+    events = [_serve_compile_ev(8, 8e9), _serve_compile_ev(1, 1e9)]
+    plan = plan_serve(events, buckets=(1, 8), rate_rps=500.0)
+    assert plan["replicas"] >= 1 and plan["sized_by"] == "ledger"
+    assert set(plan["per_bucket"]) == {"1", "8"}
+    assert plan["per_replica_capacity_rps"] > 0
+    # a deadline no bucket's service time fits keeps the smallest bucket
+    # (refusing all traffic would be worse; the attainment gate surfaces it)
+    tight = plan_serve(
+        events, buckets=(1, 8), rate_rps=500.0,
+        classes=parse_slo_classes("gold:priority=0:deadline_ms=0.000001"),
+    )
+    assert tight["buckets"] == [1]
+    # capacity is priced from the ladder the replicas actually serve,
+    # never from a deadline-trimmed-out bucket's throughput
+    assert tight["best_bucket"] in tight["buckets"]
+    assert tight["replicas"] >= plan["replicas"]
+    # no serve ledger at all: one replica, honestly labeled
+    empty = plan_serve([], buckets=(1, 8), rate_rps=500.0)
+    assert empty["replicas"] == 1
+    assert empty["sized_by"] == "no-serve-ledger"
+
+
+# ------------------------------------------- run_report --serve SLO gate
+
+
+def _route_event(bus, classes):
+    bus.emit("serve_route", state="routing", classes=classes)
+
+
+def test_serve_report_gates_on_attainment(tmp_path, capsys):
+    ok_dir, bad_dir = tmp_path / "ok", tmp_path / "bad"
+    for d, ok_deadline in ((ok_dir, 10), (bad_dir, 5)):
+        bus = obs.EventBus(run_id="c" * 16)
+        bus.bind_dir(d)
+        _route_event(bus, {
+            "gold": {
+                "completed": 10, "ok_deadline": ok_deadline, "expired": 0,
+                "shed": 0, "priority": 0, "deadline_ms": 100.0,
+                "target": 0.9,
+            },
+            "bulk": {
+                "completed": 5, "ok_deadline": 5, "expired": 0, "shed": 0,
+                "priority": 2, "deadline_ms": None, "target": 0.0,
+            },
+        })
+    assert run_report.serve_report(ok_dir) == 0
+    assert "all SLO targets met" in capsys.readouterr().out
+    assert run_report.serve_report(bad_dir) == 1
+    assert "BELOW TARGET" in capsys.readouterr().out
+    # an empty root is an error; a root with no serving session is not
+    assert run_report.serve_report(tmp_path / "void") == 2
+
+
+def test_serve_class_table_sums_sessions_cumulative_last_wins(tmp_path):
+    bus = obs.EventBus(run_id="d" * 16)
+    bus.bind_dir(tmp_path)
+    row = {"completed": 3, "ok_deadline": 3, "expired": 0, "shed": 0,
+           "priority": 0, "deadline_ms": 50.0, "target": 0.5}
+    _route_event(bus, {"gold": dict(row)})
+    _route_event(bus, {"gold": dict(row, completed=7, ok_deadline=6)})
+    table = run_report.serve_class_table(
+        obs.load_events(Path(tmp_path) / "events.jsonl")
+    )
+    # cumulative semantics: the LAST event of the session wins, not the sum
+    assert table["gold"]["completed"] == 7
+    assert table["gold"]["ok_deadline"] == 6
+
+
+# ------------------------------------------ per-class OpenMetrics labels
+
+
+def test_split_labels_grammar():
+    assert split_labels("serve/latency_s{class=gold}") == (
+        "serve/latency_s", {"class": "gold"}
+    )
+    assert split_labels("serve/latency_s") == ("serve/latency_s", {})
+    # non-label brace junk passes through untouched
+    assert split_labels("weird{notlabels}") == ("weird{notlabels}", {})
+
+
+def test_render_openmetrics_groups_label_variants_into_one_family():
+    m = ServeMetrics()
+    m.record_request_done(0.010, cls="gold")
+    m.record_request_done(0.020, cls="bulk")
+    m.record_request_done(0.015)
+    snaps = {}
+    for st in m._class_stats.values():
+        snaps[st.hist.name] = st.hist.snapshot(reset=False)
+    snaps["serve/latency_s"] = m._latency_hist.snapshot(reset=False)
+    snaps["serve/shed_total{class=gold}"] = {"type": "counter", "n": 2}
+    text = render_openmetrics(metrics=snaps)
+    # ONE # TYPE line for the shared family, every variant under it
+    assert text.count("# TYPE dtc_serve_latency_s histogram") == 1
+    assert 'dtc_serve_latency_s_count{class="gold"} 1' in text
+    assert 'dtc_serve_latency_s_count{class="bulk"} 1' in text
+    assert "dtc_serve_latency_s_count 3" in text  # the unlabeled global
+    assert 'dtc_serve_shed_total_total{class="gold"} 2' in text
+    assert text.rstrip().endswith("# EOF")
+
+
+# ----------------------------------------------- chaos + flag validation
+
+
+def test_serve_flash_rewarm_scenario_is_registered():
+    sc = CHAOS_SCENARIOS["serve_flash_rewarm"]
+    assert sc["session"] == "serve"
+    assert "serve_route" in sc["require_kinds"]
+    assert "--serve" in sc["extra_args"]
+    # the expectation block is satisfiable by a green run...
+    observed = {
+        "final_rc": 0, "alerts_fired": 2, "policy_completed": 1,
+        "recompiles": 1, "p99_recovered": True, "policy_dry_run": 0,
+    }
+    assert check_chaos_expectations(sc["expect"], observed) == []
+    # ...and actually binds on the recovery claim
+    assert check_chaos_expectations(
+        sc["expect"], dict(observed, p99_recovered=False)
+    )
+
+
+def test_serve_fleet_flags_parse_and_validate():
+    hp = load_config("tpu", argv=[
+        "--serve", "--serve-replicas", "2", "--serve-mode", "bucketed",
+        "--serve-buckets", "1,4,8", "--serve-warm-buckets", "4,1",
+        "--serve-classes", "gold:priority=0:deadline_ms=250:target=0.99",
+        "--serve-shape", "flash", "--serve-flash-mult", "4",
+    ])
+    assert hp.serve_replicas == 2 and hp.serve_mode == "bucketed"
+    assert hp.serve_warm_buckets == (1, 4)
+    with pytest.raises(SystemExit):  # warm bucket outside the ladder
+        load_config("tpu", argv=[
+            "--serve-buckets", "1,4", "--serve-warm-buckets", "8",
+        ])
+    with pytest.raises(SystemExit):  # malformed class spec dies at the CLI
+        load_config("tpu", argv=["--serve-classes", "gold:bogus=1"])
+    with pytest.raises(SystemExit):  # negative replica count
+        load_config("tpu", argv=["--serve-replicas", "-1"])

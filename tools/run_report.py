@@ -19,6 +19,10 @@ Usage::
                                                       # prediction vs measured;
                                                       # rc=1 when an installed
                                                       # plan was ignored
+    python tools/run_report.py CKPT_ROOT --serve      # per-SLO-class serving
+                                                      # attainment table; rc=1
+                                                      # on any class below its
+                                                      # target
     python tools/run_report.py CKPT_ROOT --export-openmetrics [OUT]
                                                       # offline scrape render
     python tools/run_report.py CKPT_ROOT --xplane OUT.json \\
@@ -895,6 +899,138 @@ def policy_report(path: str | Path, out=print) -> int:
     return 0
 
 
+def serve_class_table(events: list[dict]) -> dict[str, dict]:
+    """Per-SLO-class serving totals from the merged stream alone.
+
+    ``serve_route`` events carry CUMULATIVE per-class counters, so the
+    LAST event per ``(run_id, attempt, process_index, router)`` is that
+    router session's state (the ``router`` token keeps sequential
+    routers of one process apart); sessions sum.  Each class row:
+    completed / ok_deadline / expired / shed / failed, attainment =
+    ok_deadline ÷ terminal, and the class's configured
+    deadline/target/priority (carried on the same events — the gate
+    needs no flags re-supplied)."""
+    last: dict[tuple, dict] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "serve_route":
+            continue
+        p = _payload(ev)
+        if not p.get("classes"):
+            continue
+        key = (
+            ev.get("run_id"), int(ev.get("attempt", 0) or 0),
+            int(ev.get("process_index", 0) or 0), p.get("router"),
+        )
+        last[key] = p  # stream is time-ordered; later wins
+    table: dict[str, dict] = {}
+    for p in last.values():
+        for name, row in (p.get("classes") or {}).items():
+            agg = table.setdefault(
+                name,
+                {
+                    "completed": 0, "ok_deadline": 0, "expired": 0,
+                    "shed": 0, "failed": 0,
+                    "priority": row.get("priority"),
+                    "deadline_ms": row.get("deadline_ms"),
+                    "target": row.get("target"),
+                },
+            )
+            for k in ("completed", "ok_deadline", "expired", "shed",
+                      "failed"):
+                agg[k] += int(row.get(k, 0) or 0)
+            # config fields: prefer any session that carried them
+            for k in ("priority", "deadline_ms", "target"):
+                if agg[k] is None and row.get(k) is not None:
+                    agg[k] = row[k]
+    for agg in table.values():
+        terminal = (
+            agg["completed"] + agg["expired"] + agg["shed"] + agg["failed"]
+        )
+        agg["terminal"] = terminal
+        agg["attainment"] = (
+            agg["ok_deadline"] / terminal if terminal else None
+        )
+    return table
+
+
+def serve_report(path: str | Path, out=print) -> int:
+    """The ``--serve`` view: the per-class SLO attainment table from the
+    event stream alone.  Exit 0 when every class with a declared target
+    meets it (including when there are no ``serve_route`` events — a
+    run that never served is not unhealthy), 1 when any class is below
+    its target, 2 when ``path`` holds no events whatsoever."""
+    events, _files = load_run(path)
+    if not events:
+        out(f"{path}: no events found")
+        return 2
+    table = serve_class_table(events)
+    if not table:
+        out(f"{path}: no serve_route events (no serving session, or the "
+            "router never emitted)")
+        return 0
+    routes = [
+        ev for ev in events
+        if isinstance(ev, dict) and ev.get("kind") == "serve_route"
+    ]
+    plans = [
+        _payload(ev)["plan"] for ev in routes if _payload(ev).get("plan")
+    ]
+    if plans:
+        plan = plans[-1]
+        out(
+            f"capacity plan: {plan.get('replicas')} replica(s), ladder "
+            f"{plan.get('buckets')} (sized_by {plan.get('sized_by')}, fit "
+            f"{(plan.get('fit') or {}).get('source')})"
+        )
+    header = (
+        f"{'class':<12} {'prio':>4} {'deadline':>9} {'offered':>8} "
+        f"{'ok':>7} {'expired':>8} {'shed':>6} {'failed':>7} "
+        f"{'attain':>7} {'target':>7}  verdict"
+    )
+    out(header)
+    out("-" * len(header))
+    rc = 0
+    for name in sorted(
+        table, key=lambda n: (table[n].get("priority") or 0, n)
+    ):
+        row = table[name]
+        target = float(row.get("target") or 0.0)
+        att = row["attainment"]
+        below = target > 0 and (att is None or att < target)
+        if below:
+            rc = 1
+        out(
+            f"{name:<12} "
+            f"{row.get('priority') if row.get('priority') is not None else '-':>4} "
+            f"{(str(round(row['deadline_ms'], 1)) + 'ms') if row.get('deadline_ms') else '-':>9} "
+            f"{row['terminal']:>8} {row['ok_deadline']:>7} "
+            f"{row['expired']:>8} {row['shed']:>6} {row['failed']:>7} "
+            f"{(f'{att * 100:.1f}%' if att is not None else '-'):>7} "
+            f"{(f'{target * 100:.1f}%' if target else '-'):>7}  "
+            + ("BELOW TARGET" if below else "ok")
+        )
+    # replica lifecycle recap: dead replicas are worth a line even when
+    # every SLO held (the fleet absorbed the failure — say so)
+    dead = [
+        _payload(ev)
+        for ev in events
+        if isinstance(ev, dict) and ev.get("kind") == "replica"
+        and _payload(ev).get("state") == "dead"
+    ]
+    if dead:
+        out(
+            f"replicas declared dead: "
+            + ", ".join(
+                f"{p.get('replica')} ({p.get('reason', '?')})" for p in dead
+            )
+        )
+    if rc:
+        out("one or more classes BELOW their SLO target")
+    else:
+        out("all SLO targets met")
+    return rc
+
+
 def _plan_layout_of_run_start(p: dict) -> dict:
     """The layout a ``run_start`` payload actually ran — the comparison
     frame of a ``plan`` event's ``layout`` dict."""
@@ -1510,6 +1646,14 @@ def main(argv: list[str]) -> int:
         "ignored plan must fail the stream check",
     )
     ap.add_argument(
+        "--serve", action="store_true",
+        help="print the per-SLO-class serving attainment table "
+        "reconstructed from the serve_route events alone (+ the "
+        "installed capacity plan and any dead replicas); exit 1 when "
+        "any class with a declared target is below it — the serve "
+        "bench leg's self-check",
+    )
+    ap.add_argument(
         "--export-openmetrics", metavar="OUT", default=None, nargs="?",
         const="-",
         help="render the run's merged metrics/heartbeats/alerts in the "
@@ -1556,6 +1700,12 @@ def main(argv: list[str]) -> int:
         rc = 0
         for path in args.paths:
             rc = max(rc, plan_report(path))
+        return rc
+
+    if args.serve:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, serve_report(path))
         return rc
 
     if args.export_openmetrics is not None:
