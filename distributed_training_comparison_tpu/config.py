@@ -709,6 +709,46 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         help="Seed for prob= fault-plan draws (deterministic per "
         "(seed, kind, epoch))",
     )
+    # eager-parity debug rail (parity/ subsystem: record the first N real
+    # steps, replay them through the same executable family bitwise, and
+    # diff against the no-jit eager reference under a ulp tolerance)
+    parser.add_argument(
+        "--parity-check",
+        type=int,
+        default=0,
+        help="Record the first N steps of the first trained epoch (one "
+        "step per dispatch — bit-identical by the runners' chunking "
+        "contract), then replay them through a fresh instance of the same "
+        "scanned executable (bitwise replay gate) and through the eager "
+        "no-jit reference rail (tolerance-gated). Emits one 'parity' "
+        "event; render/gate it with tools/run_report.py --parity. "
+        "Single-process debug rail; 0 disables",
+    )
+    parser.add_argument(
+        "--parity-tol",
+        type=str,
+        default=f"ulp={1 << 26}",
+        help="Reference-gate tolerance: 'bitwise' (exact — expected to "
+        "fail for any real layout, XLA fusion re-associates float math) "
+        "or 'ulp=K' (scale-aware: max |a-b| within K float32 ulps at the "
+        "leaf's largest magnitude). Measured bands on the 8-device CPU "
+        "mesh: conv-family dp-only fp32 ~2^6-2^8; attention trunks, "
+        "tp/pp splits, and the fp16/int8 wire tiers all reassociate "
+        "into ~2^23-2^25. The default covers every stock layout; "
+        "TIGHTEN per run by capturing once with a loose K and reading "
+        "max_ulp off the event (e.g. ulp=1024 for conv dp runs). The "
+        "replay gate is always bitwise regardless",
+    )
+    parser.add_argument(
+        "--parity-corrupt",
+        type=str,
+        default=None,
+        help="Silicon-fault simulator for the parity rail, "
+        "'STEP:BIT:LEAF-SUBSTRING': after capture step STEP, flip bit BIT "
+        "of element 0 of the first state leaf matching the substring in "
+        "the REAL carried state; the clean replay must localize the flip "
+        "to exactly that (step, leaf)",
+    )
     parser.add_argument(
         "--goodput-json",
         type=str,
@@ -1049,6 +1089,26 @@ def load_config(
         parser.error(
             f"--heartbeat-secs must be >= 0, got {args.heartbeat_secs}"
         )
+    if args.parity_check < 0:
+        parser.error(
+            f"--parity-check must be >= 0, got {args.parity_check}"
+        )
+    if args.parity_check or args.parity_corrupt:
+        # malformed tolerance/corrupt specs die at the CLI, not after the
+        # capture epoch already trained (same contract as --alert/--policy)
+        from .parity import Tolerance, parse_corrupt
+
+        try:
+            Tolerance.parse(args.parity_tol)
+        except ValueError as e:
+            parser.error(str(e))
+        if args.parity_corrupt:
+            try:
+                parse_corrupt(args.parity_corrupt)
+            except ValueError as e:
+                parser.error(str(e))
+        if args.parity_corrupt and not args.parity_check:
+            parser.error("--parity-corrupt requires --parity-check N")
     if not 0 <= args.metrics_port <= 65535:
         parser.error(
             f"--metrics-port must be in [0, 65535], got {args.metrics_port}"

@@ -35,8 +35,11 @@ a deterministic, seeded detect→rollback→converge-anyway e2e test.
 from .desync import (
     check_desync,
     check_partial_desync,
+    fingerprint_leaves,
+    fold_fingerprint,
     gather_fingerprints,
     gather_partial_fingerprints,
+    leaf_checksum,
     make_partial_fingerprint_fn,
     param_fingerprint,
     partial_fingerprints,
@@ -54,6 +57,9 @@ from .watchdog import (
 __all__ = [
     "check_desync",
     "check_partial_desync",
+    "fingerprint_leaves",
+    "fold_fingerprint",
+    "leaf_checksum",
     "gather_fingerprints",
     "gather_partial_fingerprints",
     "make_partial_fingerprint_fn",

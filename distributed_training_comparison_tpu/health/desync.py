@@ -36,6 +36,49 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def leaf_checksum(leaf) -> jnp.ndarray:
+    """ONE leaf's exact wrapping-int32 bitcast checksum (jittable, and
+    equally happy running eagerly on a host copy).
+
+    Non-4-byte leaves widen to f32 first — bf16/f16 → f32 is lossless, so
+    every element bitcasts to exactly one int32 — then the bits accumulate
+    with WRAPPING int32 addition: exact modular arithmetic, no float
+    rounding to absorb a low-order-bit drift.  ANY differing bit in the
+    leaf (including NaN-payload differences a float abs-sum erases)
+    changes the value.  This is the single checksum implementation shared
+    by the fleet watchdog (``make_partial_fingerprint_fn``) and the
+    eager-parity bisector (``parity/diff.py``) — one walk, nothing to
+    drift."""
+    if leaf.dtype.itemsize != 4:
+        leaf = leaf.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(leaf, jnp.int32)
+    return jnp.sum(bits, dtype=jnp.int32)
+
+
+def fingerprint_leaves(tree) -> tuple[tuple[str, ...], jnp.ndarray]:
+    """Per-leaf checksum walk over a pytree: ``(paths, checksums)`` where
+    ``paths`` are ``jax.tree_util.keystr`` leaf paths (trace-time
+    constants) and ``checksums`` is an int32 ``(n_leaves,)`` vector of
+    :func:`leaf_checksum` values.  Jittable; an empty tree returns
+    ``((), int32[0])``."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    paths = tuple(jax.tree_util.keystr(p) for p, _ in flat)
+    if not flat:
+        return paths, jnp.zeros((0,), jnp.int32)
+    return paths, jnp.stack([leaf_checksum(leaf) for _, leaf in flat])
+
+
+def fold_fingerprint(checksums: jnp.ndarray) -> jnp.ndarray:
+    """Fold a per-leaf checksum vector into ONE int32 scalar under the
+    position weight ``(i % 31) + 1`` (wrapping arithmetic throughout) —
+    the reduction the device-path fleet fingerprint ships per device."""
+    n = checksums.shape[0]
+    if n == 0:
+        return jnp.zeros((), jnp.int32)
+    weights = (jnp.arange(n, dtype=jnp.int32) % 31) + 1
+    return jnp.sum(checksums * weights, dtype=jnp.int32)
+
+
 def param_fingerprint(params) -> jnp.ndarray:
     """Per-leaf checksum reduced to one f32 scalar.  Pure/jittable — the
     Trainer jits it once and calls it per check (the reduction fuses into
@@ -100,17 +143,9 @@ def make_partial_fingerprint_fn(mesh, param_shardings=None):
         )
 
     def local(params):
-        total = jnp.zeros((), jnp.int32)
-        for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
-            if leaf.dtype.itemsize != 4:
-                # exact widening (bf16/f16 → f32 is lossless) so every
-                # leaf bitcasts to one int32 per element
-                leaf = leaf.astype(jnp.float32)
-            bits = jax.lax.bitcast_convert_type(leaf, jnp.int32)
-            total = total + jnp.sum(bits, dtype=jnp.int32) * jnp.int32(
-                (i % 31) + 1
-            )
-        return total
+        # the shared per-leaf walk + position-weighted fold — the SAME
+        # implementation the eager-parity bisector compares states with
+        return fold_fingerprint(fingerprint_leaves(params)[1])
 
     axis_names = tuple(mesh.axis_names)
 

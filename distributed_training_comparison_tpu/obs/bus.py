@@ -103,6 +103,12 @@ KNOWN_KINDS = {
     # installed capacity plan — the stream-only input of
     # `run_report --serve`'s attainment gate
     "replica", "serve_route",
+    # eager-parity debug rail (parity/): one event per completed
+    # --parity-check capture — both gate verdicts (bitwise replay vs the
+    # recorded trajectory, tolerance-gated eager reference), the first
+    # divergent (step, stage, leaf, ulp) when either gate trips, and the
+    # layout under test; run_report --parity renders and gates on it
+    "parity",
 }
 
 

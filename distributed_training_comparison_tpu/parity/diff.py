@@ -1,0 +1,497 @@
+"""Trajectory bisection: compiled vs recorded vs eager, to (step, stage, leaf).
+
+``parity/eager.py`` gives every planned layout a reference rail; this
+module runs the rails side by side over the first ``--parity-check N``
+steps of the real run and names the FIRST divergence instead of eyeballing
+a loss delta.  Two gates, because two different things can break:
+
+- **replay gate (always bitwise)** — a fresh dispatch of the SAME scanned
+  executable family that produced the recording (``train/step.py``
+  ``make_replay_step`` / ``make_device_replay_step``: chunk runner at
+  K=1, ``donate=False`` — chunk size and donation are bitwise-neutral,
+  the repo's pinned runner contract) against the per-step per-leaf
+  checksums recorded from the REAL run's dispatches.  Determinism says
+  these must be bit-equal; a mismatch means the recorded trajectory
+  contains math the program does not reproduce — silent data corruption,
+  a non-deterministic kernel, or an injected fault — localized to the
+  exact step and leaf by binary search over the recorded per-leaf
+  wrapping-int32 bitcast checksums (``health/desync.fingerprint_leaves``,
+  the SAME walk the fleet watchdog ships per device).
+- **reference gate (tolerance-gated)** — the compiled replay against the
+  eager rail.  XLA fusion legitimately re-associates float math, so even
+  fp32 on one CPU device drifts a few ulp per step, and under dp=8 the
+  cross-replica reduction order scrambles near-zero momentum elements by
+  MILLIONS of lexicographic ulps while the trajectory is numerically
+  sound.  The gate therefore measures SCALE-AWARE ulp distance
+  (:func:`ulp_distance`): the max elementwise |a-b| in units of one
+  float32 ulp at the leaf's largest magnitude — identical to classic ulp
+  distance for elements at tensor scale, robust at the noise floor.
+  ``--parity-tol ulp=K`` prices the re-association; ``bitwise`` demands
+  exact bit equality (the degenerate point of the lattice — expected to
+  fail for any real layout, which is precisely the fp16/int8 wire-tier
+  contrast the tests pin).
+
+On a divergence the engine binary-searches the step's transform pipeline
+— ``grads → wire → optimizer → relayout`` — using each stage's observable
+footprint in the carried state (loss bits + BN stats for the forward/
+backward, the error-feedback residual for the wire, momentum for the
+optimizer, params for the final apply/re-layout), then binary-searches
+across the leaf walk to name the first divergent leaf path and its
+distance.  The result is ONE registered ``parity`` event whose payload
+``tools/run_report.py --parity`` renders and gates on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ..health.desync import fingerprint_leaves
+
+# the step's transform pipeline, in execution order; each stage is judged
+# by the divergence first visible in its footprint on the carried state
+STAGES = ("grads", "wire", "optimizer", "relayout")
+
+# which top-level state component each stage writes (loss bits are the
+# grads stage's second witness — a faulted backward scales the loss too)
+_STAGE_COMPONENTS = {
+    "grads": ("batch_stats",),
+    "wire": ("comms_residual",),
+    "optimizer": ("opt_state",),
+    "relayout": ("params", "step"),
+}
+
+_INT_DIVERGED = float((1 << 31) - 1)  # sentinel distance: non-float mismatch
+
+
+@dataclasses.dataclass(frozen=True)
+class Tolerance:
+    """``--parity-tol``: ``bitwise`` or ``ulp=K`` (K ≥ 0)."""
+
+    mode: str  # "bitwise" | "ulp"
+    ulp: int = 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "Tolerance":
+        s = str(spec).strip().lower()
+        if s == "bitwise":
+            return cls("bitwise")
+        if s.startswith("ulp="):
+            try:
+                k = int(s[4:])
+            except ValueError:
+                k = -1
+            if k >= 0:
+                return cls("ulp", k)
+        raise ValueError(
+            f"--parity-tol must be 'bitwise' or 'ulp=K' (K >= 0), got {spec!r}"
+        )
+
+    def exceeded(self, dist: float | None) -> bool:
+        """Does a measured distance violate this tolerance?  ``None``
+        (incomparable shapes) always violates; ``bitwise`` accepts only
+        exact bit equality (distance 0)."""
+        if dist is None:
+            return True
+        if self.mode == "bitwise":
+            return dist != 0
+        return dist > self.ulp
+
+    def __str__(self) -> str:
+        return "bitwise" if self.mode == "bitwise" else f"ulp={self.ulp}"
+
+
+def ulp_distance(a, b) -> float | None:
+    """Scale-aware ulp distance between two same-shaped arrays.
+
+    ``max |a - b|`` measured in units of one float32 ulp at the pair's
+    largest-magnitude element (``np.spacing`` of the shared scale).  For
+    elements near tensor scale this is the classic lexicographic distance
+    (adjacent representables → 1); for noise-floor elements it prices the
+    ABSOLUTE error against the leaf's scale instead of exploding — under
+    dp=8 the cross-replica reduction order legitimately flips signs of
+    ~1e-12 elements in ~1e-2 leaves, which is sub-ulp noise here but
+    millions of ulps in the elementwise key space.  Half-width floats
+    compare after widening (a one-ulp bf16 step ≈ 2^16 here; pick K
+    accordingly).  Exact bit equality returns 0.0 and is the ONLY way to
+    get 0.0 (zero-sign/NaN-payload-only differences return 0.5), so
+    ``bitwise`` tolerance composes.  Non-float leaves are exact: 0.0 when
+    equal, a huge sentinel otherwise.  ``None`` when the shapes don't
+    match (incomparable layouts); differing NaN/inf placement is ``inf``.
+    """
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        return None
+    if a.size == 0:
+        return 0.0
+    if a.dtype == b.dtype and a.tobytes() == b.tobytes():
+        return 0.0
+    a_f = np.issubdtype(a.dtype, np.floating)
+    b_f = np.issubdtype(b.dtype, np.floating)
+    if not (a_f and b_f):
+        return 0.0 if np.array_equal(a, b) else _INT_DIVERGED
+    a64 = a.astype(np.float64)
+    b64 = b.astype(np.float64)
+    na, nb = np.isnan(a64), np.isnan(b64)
+    if not np.array_equal(na, nb):
+        return float("inf")
+    if na.any():
+        a64 = np.where(na, 0.0, a64)
+        b64 = np.where(na, 0.0, b64)
+    ia, ib = np.isinf(a64), np.isinf(b64)
+    if ia.any() or ib.any():
+        if not np.array_equal(np.where(ia, np.sign(a64), 2.0),
+                              np.where(ib, np.sign(b64), 2.0)):
+            return float("inf")
+        a64 = np.where(ia, 0.0, a64)
+        b64 = np.where(ib, 0.0, b64)
+    scale = max(float(np.max(np.abs(a64))), float(np.max(np.abs(b64))),
+                float(np.finfo(np.float32).tiny))
+    unit = float(np.spacing(np.float32(scale)))
+    d = float(np.max(np.abs(a64 - b64)))
+    if d == 0.0:
+        return 0.5  # bits differ only in zero sign or NaN payload
+    return d / unit
+
+
+def f32_bits(x) -> int:
+    """A float32 scalar's raw bit pattern (the loss-trace compare key)."""
+    return int(np.asarray(x, np.float32).reshape(()).view(np.uint32))
+
+
+def parse_corrupt(spec: str) -> tuple[int, int, str]:
+    """``--parity-corrupt STEP:BIT:LEAF`` → ``(step, bit, leaf_substr)``.
+
+    The parity rail's silicon-fault simulator: right after capture step
+    STEP's dispatch returns — before its checksums are recorded — the
+    trainer flips bit BIT of element 0 of the first state leaf whose path
+    contains LEAF, in the REAL carried state.  The recorded trajectory
+    carries the flip from STEP on; the replay runs clean, so the diff must
+    localize it to exactly that (step, leaf)."""
+    parts = str(spec).split(":", 2)
+    if len(parts) != 3 or not parts[2]:
+        raise ValueError(
+            f"--parity-corrupt must be STEP:BIT:LEAF-SUBSTRING, got {spec!r}"
+        )
+    try:
+        step, bit = int(parts[0]), int(parts[1])
+    except ValueError as e:
+        raise ValueError(
+            f"--parity-corrupt must be STEP:BIT:LEAF-SUBSTRING, got {spec!r}"
+        ) from e
+    if step < 0 or not (0 <= bit < 32):
+        raise ValueError(
+            f"--parity-corrupt needs STEP >= 0 and 0 <= BIT < 32, got {spec!r}"
+        )
+    return step, bit, parts[2]
+
+
+def corrupt_bitflip(state, leaf_substr: str, bit: int):
+    """Flip one bit of element 0 of the first 4-byte state leaf whose path
+    contains ``leaf_substr``; returns ``(new_state, leaf_path)``.  The new
+    leaf is placed back with the original leaf's sharding, so the corrupted
+    state carries on through the real runners untouched otherwise."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    for i, (path, leaf) in enumerate(flat):
+        p = jax.tree_util.keystr(path)
+        if leaf_substr not in p:
+            continue
+        if not hasattr(leaf, "dtype") or leaf.size == 0:
+            continue
+        if np.dtype(leaf.dtype).itemsize != 4:
+            continue
+        host = np.array(jax.device_get(leaf))
+        words = host.reshape(-1).view(np.uint32)
+        words[0] ^= np.uint32(1) << np.uint32(bit)
+        placed = jax.device_put(host, getattr(leaf, "sharding", None))
+        leaves = [l for _, l in flat]
+        leaves[i] = placed
+        return jax.tree_util.tree_unflatten(treedef, leaves), p
+    raise ValueError(
+        f"--parity-corrupt: no 4-byte state leaf matches {leaf_substr!r}"
+    )
+
+
+@dataclasses.dataclass
+class StepRecord:
+    """One recorded step of the real run: the rails' inputs (host batch +
+    per-step key + the effective step-fault scale) and the real rail's
+    footprint (per-leaf state checksums + the step's loss bits)."""
+
+    index: int
+    images: np.ndarray
+    labels: np.ndarray
+    key: object
+    fault_scale: float
+    checksums: np.ndarray
+    loss_bits: int
+
+
+class ParityCapture:
+    """The trainer-side record of the real run's first N steps.
+
+    Holds the initial state snapshot (host copy, taken before step 0 of
+    the capture epoch), the per-step :class:`StepRecord` list, and the
+    optional ``--parity-corrupt`` spec.  The trainer fills it during the
+    first N dispatches of the capture epoch — forced to one step per
+    dispatch, which is bit-identical to any other chunking by the
+    runners' pinned contract — and hands it to :func:`run_parity_check`
+    once complete."""
+
+    def __init__(self, n: int, tol: Tolerance, corrupt: str | None = None):
+        self.n = int(n)
+        self.tol = tol
+        self.corrupt = parse_corrupt(corrupt) if corrupt else None
+        self.corrupted_leaf: str | None = None
+        self.mode: str | None = None
+        self.epoch: int | None = None
+        self.initial = None
+        self.leaf_paths: tuple[str, ...] | None = None
+        self.records: list[StepRecord] = []
+        self.checked = False
+
+    @property
+    def complete(self) -> bool:
+        return len(self.records) >= self.n
+
+    @property
+    def capturing(self) -> bool:
+        return not self.complete
+
+    def snapshot_initial(self, state, mode: str, epoch: int) -> None:
+        self.initial = jax.device_get(state)
+        self.mode = mode
+        self.epoch = int(epoch)
+        self.leaf_paths = tuple(
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(self.initial)[0]
+        )
+
+    def record(self, rec: StepRecord) -> None:
+        self.records.append(rec)
+
+    def maybe_corrupt(self, state, index: int):
+        """Apply the ``--parity-corrupt`` bit flip when ``index`` is the
+        corrupt step (idempotent otherwise): returns the (possibly)
+        corrupted state to carry on with.  Call between a step's dispatch
+        and its :meth:`record` — the flip lands in the recorded trajectory
+        and in every later real step, while the replay stays clean."""
+        if self.corrupt is None or int(index) != self.corrupt[0]:
+            return state
+        if self.corrupted_leaf is not None:
+            return state
+        state, leaf = corrupt_bitflip(state, self.corrupt[2], self.corrupt[1])
+        self.corrupted_leaf = leaf
+        return state
+
+
+def checksum_state(state) -> np.ndarray:
+    """Per-leaf wrapping-int32 bitcast checksums of a (host or device)
+    state tree — the recorded footprint the replay gate compares against.
+    One implementation: ``health/desync.fingerprint_leaves``."""
+    host = jax.device_get(state)
+    return np.asarray(jax.device_get(fingerprint_leaves(host)[1]))
+
+
+def _component(path: str) -> str:
+    """Which TrainState field a ``keystr`` leaf path lives under."""
+    head = path.lstrip(".").lstrip("[").lstrip("'\"")
+    for name in ("params", "batch_stats", "opt_state", "comms_residual", "step"):
+        if head.startswith(name):
+            return name
+    return "params"  # unknown layouts: judged with the params stage
+
+
+def _first_divergent_stage(loss_diverged: bool, divergent_components: set) -> str:
+    """Binary-search the transform pipeline for the first stage whose
+    footprint diverged.
+
+    ``prefix(i)`` — "divergence visible at or before stage i" — is
+    monotone in ``i`` (once any earlier footprint diverged it stays
+    divergent for every later prefix), so bisection over the four-stage
+    pipeline finds the first hit in ≤2 probes."""
+
+    def stage_hit(stage: str) -> bool:
+        if stage == "grads" and loss_diverged:
+            return True
+        return any(
+            c in divergent_components for c in _STAGE_COMPONENTS[stage]
+        )
+
+    def prefix(i: int) -> bool:
+        return any(stage_hit(s) for s in STAGES[: i + 1])
+
+    lo, hi = 0, len(STAGES) - 1
+    if not prefix(hi):
+        return "relayout"  # nothing in the footprint map: params by default
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if prefix(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    return STAGES[lo]
+
+
+def _first_divergent_leaf(recorded: np.ndarray, replayed: np.ndarray):
+    """Binary search across the leaf walk for the first divergent leaf.
+
+    The predicate "checksum prefix ``[0, m)`` matches" is monotone
+    non-increasing in ``m``, so bisection names the first mismatch in
+    O(log L) prefix compares — the leaf-axis twin of the watchdog's
+    partial-fingerprint narrowing.  Returns ``None`` when the walks are
+    identical."""
+    n = int(recorded.shape[0])
+    if n != int(replayed.shape[0]):
+        return 0 if n and replayed.shape[0] else None
+    if np.array_equal(recorded, replayed):
+        return None
+    lo, hi = 0, n  # prefix[:lo] matches; prefix[:hi] differs
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if np.array_equal(recorded[:mid], replayed[:mid]):
+            lo = mid
+        else:
+            hi = mid
+    return hi - 1
+
+
+def _divergence_payload(step, stage, leaf, dist, extra=None) -> dict:
+    out = {"step": int(step), "stage": stage, "leaf": leaf,
+           "ulp": None if dist is None else float(dist)}
+    if extra:
+        out.update(extra)
+    return out
+
+
+def run_parity_check(
+    capture: ParityCapture,
+    *,
+    replay_step,
+    place_state=None,
+    eager_step=None,
+    eager_state=None,
+    eager_unsupported_reason: str | None = None,
+    layout: dict | None = None,
+) -> dict:
+    """Run both gates over a completed capture; returns the ``parity``
+    event payload (see module docstring for the gate semantics).
+
+    ``replay_step(state, rec) -> (state, metrics)`` must dispatch the SAME
+    executable family that produced the recording (the trainer composes it
+    from ``make_replay_step`` / ``make_device_replay_step``).
+    ``eager_step(state, rec) -> (state, metrics)`` is the no-jit rail
+    (``parity/eager.py``); ``None`` marks the reference gate unsupported
+    for this layout, with ``eager_unsupported_reason`` naming why.
+    ``place_state`` places the host-side initial snapshot onto the run's
+    real layout (defaults to an uncommitted ``jax.device_put``)."""
+    assert capture.complete and capture.initial is not None
+    tol = capture.tol
+    paths = capture.leaf_paths
+
+    cstate = (
+        place_state(capture.initial) if place_state is not None
+        else jax.device_put(capture.initial)
+    )
+    estate = eager_state if eager_state is not None else capture.initial
+    eager_ok = eager_step is not None
+
+    replay_div = None
+    ref_div = None
+    max_ulp = 0.0
+
+    for rec in capture.records:
+        cstate, cmetrics = replay_step(cstate, rec)
+        if replay_div is None:
+            cks = checksum_state(cstate)
+            closs = f32_bits(jax.device_get(cmetrics["loss"]))
+            first = _first_divergent_leaf(np.asarray(rec.checksums), cks)
+            loss_diverged = closs != rec.loss_bits
+            if first is not None or loss_diverged:
+                bad = np.nonzero(cks != np.asarray(rec.checksums))[0]
+                comps = {_component(paths[i]) for i in bad}
+                stage = _first_divergent_stage(loss_diverged, comps)
+                leaf = paths[first] if first is not None else None
+                replay_div = _divergence_payload(
+                    rec.index, stage, leaf, None,
+                    extra={
+                        "divergent_leaves": int(bad.size),
+                        "recorded_checksum": (
+                            int(rec.checksums[first]) if first is not None
+                            else None
+                        ),
+                        "replay_checksum": (
+                            int(cks[first]) if first is not None else None
+                        ),
+                        "loss_bits_recorded": int(rec.loss_bits),
+                        "loss_bits_replay": int(closs),
+                        "fault_scale": float(rec.fault_scale),
+                    },
+                )
+        if eager_ok and ref_div is None:
+            estate, emetrics = eager_step(estate, rec)
+            chost = jax.device_get(cstate)
+            loss_dist = ulp_distance(
+                np.asarray(jax.device_get(cmetrics["loss"]), np.float32),
+                np.asarray(emetrics["loss"], np.float32),
+            )
+            if loss_dist is not None:
+                max_ulp = max(max_ulp, loss_dist)
+            c_flat = jax.tree_util.tree_leaves(chost)
+            e_flat = jax.tree_util.tree_leaves(jax.device_get(estate))
+            dists = [ulp_distance(cl, el) for cl, el in zip(c_flat, e_flat)]
+            for d in dists:
+                if d is not None and np.isfinite(d):
+                    max_ulp = max(max_ulp, d)
+            exceeded = [i for i, d in enumerate(dists) if tol.exceeded(d)]
+            if exceeded or tol.exceeded(loss_dist):
+                comps = {_component(paths[i]) for i in exceeded}
+                stage = _first_divergent_stage(tol.exceeded(loss_dist), comps)
+                first = exceeded[0] if exceeded else None
+                ref_div = _divergence_payload(
+                    rec.index, stage,
+                    paths[first] if first is not None else None,
+                    dists[first] if first is not None else loss_dist,
+                    extra={
+                        "divergent_leaves": len(exceeded),
+                        "loss_ulp": (
+                            None if loss_dist is None else float(loss_dist)
+                        ),
+                    },
+                )
+        if replay_div is not None and (ref_div is not None or not eager_ok):
+            break
+
+    report = {
+        "steps": len(capture.records),
+        "tol": str(tol),
+        "mode": capture.mode,
+        "epoch": capture.epoch,
+        "replay": "divergent" if replay_div else "ok",
+        "eager_reference": (
+            "unsupported" if not eager_ok
+            else ("divergent" if ref_div else "ok")
+        ),
+        "max_ulp": float(round(max_ulp, 3)),
+        "replay_divergence": replay_div,
+        "reference_divergence": ref_div,
+        "layout": layout or {},
+    }
+    if not eager_ok:
+        report["eager_reference_reason"] = eager_unsupported_reason or (
+            "eager reference not modeled for this layout"
+        )
+    if capture.corrupted_leaf is not None:
+        report["corrupt"] = {
+            "step": int(capture.corrupt[0]),
+            "bit": int(capture.corrupt[1]),
+            "leaf": capture.corrupted_leaf,
+        }
+    report["verdict"] = (
+        "divergent" if (replay_div or ref_div) else "ok"
+    )
+    capture.checked = True
+    return report

@@ -391,6 +391,108 @@ def make_train_step(
     )
 
 
+# a (scale, start, stop) step-fault tuple whose window can never contain a
+# real step index: the replay rail passes it so a fault-injection replay
+# executable runs every step CLEAN (``_step_fault_scale`` selects exactly
+# 1.0 outside the window; record and replay share one executable family,
+# so the clean path is bit-reproducible)
+BENIGN_FAULT = (1.0, 1 << 30, 1 << 30)
+
+
+def make_replay_step(
+    mesh: Mesh,
+    *,
+    precision: str = "fp32",
+    augment: bool = True,
+    mean=CIFAR100_MEAN,
+    std=CIFAR100_STD,
+    state_sharding=None,
+    grad_accum: int = 1,
+    fwd_bwd=None,
+    comms=None,
+    fault_injection: bool = False,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """One-step host-mode replay for the parity rail (``parity/diff.py``).
+
+    This is NOT a fresh per-step ``jit`` of the step core: XLA fuses an
+    inlined step body differently from the same body inside a ``lax.scan``,
+    so a per-step executable drifts a few ulp from the scanned runners --
+    measured on the CPU backend, and the reason a per-step replay gate
+    could never be bitwise against a chunk-runner recording.  Instead the
+    replay IS ``make_chunk_runner`` at K=1 with ``donate=False`` -- the
+    same scan-shaped program family that produced the recording (chunk
+    size and donation are bitwise-neutral, verified by
+    ``tests/test_parity.py``), so determinism makes record vs replay
+    bit-equal on the benign path.
+
+    ``fault_injection`` must MATCH the recording run's runner family: the
+    benign fault multiply is itself not bitwise-neutral ACROSS executables
+    (a traced ``*1.0`` changes fusion even though the multiply is
+    IEEE-exact), so a fault-family recording must be replayed by a
+    fault-family executable -- fed ``BENIGN_FAULT`` so the replay runs
+    clean and any recorded fault window shows up as a localized
+    divergence.
+
+    No monitor: replay legitimately compiles mid-epoch on the debug rail
+    and must not trip the compile-sentinel alert.
+    """
+    runner = make_chunk_runner(
+        mesh, precision=precision, augment=augment, mean=mean, std=std,
+        state_sharding=state_sharding, grad_accum=grad_accum,
+        fwd_bwd=fwd_bwd, comms=comms, fault_injection=fault_injection,
+        donate=False,
+    )
+    benign = tuple(jnp.asarray(v) for v in BENIGN_FAULT)
+
+    def replay(state: TrainState, images, labels, epoch_key, index):
+        args = [state, images[None], labels[None], epoch_key,
+                jnp.asarray(index)]
+        if fault_injection:
+            args.append(benign)
+        state, stacked = runner(*args)
+        return state, {k: v[0] for k, v in stacked.items()}
+
+    return replay
+
+
+def make_device_replay_step(
+    mesh: Mesh,
+    batch_size: int,
+    *,
+    precision: str = "fp32",
+    augment: bool = True,
+    mean=CIFAR100_MEAN,
+    std=CIFAR100_STD,
+    state_sharding=None,
+    grad_accum: int = 1,
+    fwd_bwd=None,
+    comms=None,
+    fault_injection: bool = False,
+) -> Callable[..., tuple[TrainState, Metrics]]:
+    """One-step device-mode replay: ``make_device_chunk_runner`` at
+    ``chunk_steps=1`` with ``donate=False`` -- the same executable-family
+    argument as :func:`make_replay_step`.  The device key table and batch
+    rows are derived in-program from ``(data_key, epoch, index)``, so the
+    replay takes the device-resident split rather than recorded batches."""
+    runner = make_device_chunk_runner(
+        mesh, batch_size, 1, precision=precision, augment=augment,
+        mean=mean, std=std, state_sharding=state_sharding,
+        grad_accum=grad_accum, fwd_bwd=fwd_bwd, comms=comms,
+        fault_injection=fault_injection, donate=False,
+    )
+    benign = tuple(jnp.asarray(v) for v in BENIGN_FAULT)
+
+    def replay(state: TrainState, images, labels, data_key, epoch, index):
+        args = [state, images, labels, data_key, jnp.asarray(epoch),
+                jnp.asarray(index)]
+        if fault_injection:
+            args.append(benign)
+        state, stacked = runner(*args)
+        return state, {k: v[0] for k, v in stacked.items()}
+
+    return replay
+
+
 def _make_eval_core(mesh: Mesh, precision: str, mean, std):
     """Per-batch eval metrics fn shared by the one-shot step and the scanned
     runner (so the two can never diverge)."""

@@ -83,7 +83,13 @@ from . import checkpoint as ckpt
 from .async_ckpt import AsyncCheckpointer
 from .optim import configure_optimizers
 from .state import create_train_state
-from .step import make_chunk_runner, make_device_chunk_runner, make_eval_runner
+from .step import (
+    make_chunk_runner,
+    make_device_chunk_runner,
+    make_device_replay_step,
+    make_eval_runner,
+    make_replay_step,
+)
 
 
 def _pad_batches(images: np.ndarray, labels: np.ndarray, batch_size: int):
@@ -641,6 +647,27 @@ class Trainer:
                 std=test_stats[1],
                 monitor=self.compile_monitor,
                 name="test_eval_runner",
+            )
+
+        # --- eager-parity debug rail (--parity-check N)
+        self.parity = None
+        parity_n = int(getattr(hparams, "parity_check", 0) or 0)
+        if parity_n > 0:
+            from .. import parity as parity_mod
+
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "--parity-check is a single-process debug rail: it "
+                    "snapshots the full state host-side, which a "
+                    "multi-process run cannot device_get"
+                )
+            self.parity = parity_mod.ParityCapture(
+                min(parity_n, self.steps_per_epoch),
+                parity_mod.Tolerance.parse(
+                    getattr(hparams, "parity_tol", f"ulp={1 << 26}")
+                    or f"ulp={1 << 26}"
+                ),
+                getattr(hparams, "parity_corrupt", None),
             )
 
         # --- run dir, logging, provenance (process-0 only)
@@ -2346,6 +2373,182 @@ class Trainer:
             )
         return fault
 
+    # ------------------------------------------------- eager-parity capture
+    #
+    # --parity-check N records the first N steps of the first trained epoch
+    # — one step per dispatch, bit-identical to any other chunking by the
+    # runners' pinned contract — then replays them through a fresh instance
+    # of the SAME scanned executable family (bitwise replay gate) and
+    # through the no-jit eager rail (tolerance-gated reference gate).  See
+    # parity/diff.py for the gate semantics and the bisection.
+
+    def _parity_capture_for(self, epoch: int):
+        """The live capture when THIS epoch should record steps, else None
+        (the capture binds to the first trained epoch; a later epoch never
+        resumes a stale capture)."""
+        cap = self.parity
+        if cap is None or cap.checked or cap.complete:
+            return None
+        if cap.epoch is not None and cap.epoch != epoch:
+            return None
+        return cap
+
+    def _parity_begin(self, cap, epoch: int, offset: int, mode: str) -> None:
+        """Snapshot the initial state (host copy) before the capture
+        epoch's first dispatch; device mode also pre-derives the runner's
+        per-step key table and permutation rows via the parity key-table
+        helpers (the SAME fold graph the scanned runners trace)."""
+        if cap.initial is not None:
+            return
+        cap.n = min(cap.n, self.steps_per_epoch - offset)
+        cap.snapshot_initial(self.state, mode, epoch)
+        if mode == "device":
+            from .. import parity as parity_mod
+
+            n = int(self.trn_images.shape[0])
+            self._parity_rows = parity_mod.device_epoch_rows(
+                self.data_key, epoch, n, self.hparams.batch_size
+            )
+            self._parity_keys = parity_mod.device_step_keys(
+                self.data_key, epoch, self.steps_per_epoch
+            )
+
+    def _parity_record(self, cap, *, epoch, index, images, labels, key,
+                       fault, loss) -> None:
+        """Record one captured step: apply the optional --parity-corrupt
+        bit flip to the REAL carried state (the flip becomes part of the
+        recorded trajectory — the clean replay then localizes it), then
+        checksum the state and keep the rails' inputs host-side.  Runs the
+        two-gate check as soon as the capture is complete."""
+        from ..parity import StepRecord, checksum_state, f32_bits
+
+        self.state = cap.maybe_corrupt(self.state, index)
+        scale = 1.0
+        if fault is not None and fault[1] <= index < fault[2]:
+            scale = float(fault[0])
+        cap.record(StepRecord(
+            index=int(index),
+            images=np.asarray(images),
+            labels=np.asarray(labels),
+            key=key,
+            fault_scale=scale,
+            checksums=checksum_state(self.state),
+            loss_bits=f32_bits(jax.device_get(loss)),
+        ))
+        if cap.complete:
+            self._run_parity_check()
+
+    def _parity_split_chunks(self, chunks):
+        """Re-chunk the host stream to one step per dispatch while the
+        capture is filling (bit-identical by the chunk runner's any-K
+        contract); chunks pass through untouched once it completes."""
+        for start, take, batch in chunks:
+            k = 0
+            while (k < take and self.parity is not None
+                   and self.parity.capturing and not self.parity.checked):
+                yield start + k, 1, {n: v[k:k + 1] for n, v in batch.items()}
+                k += 1
+            if k == 0:
+                yield start, take, batch
+            elif k < take:
+                yield start + k, take - k, {n: v[k:] for n, v in batch.items()}
+
+    def _run_parity_check(self) -> None:
+        """Both parity gates over the completed capture, emitted as ONE
+        registered ``parity`` event (rendered/gated by ``run_report.py
+        --parity``)."""
+        from .. import parity as parity_mod
+
+        cap = self.parity
+        common = dict(
+            precision=self.precision,
+            state_sharding=self.state_sharding,
+            grad_accum=self.grad_accum,
+            fwd_bwd=self.train_fwd_bwd,
+            comms=self.comms,
+            fault_injection=self._step_faults,
+        )
+        if cap.mode == "host":
+            rp = make_replay_step(self.mesh, **common)
+            epoch_key = jax.random.fold_in(self.data_key, cap.epoch)
+
+            def replay(st, rec):
+                return rp(st, jnp.asarray(rec.images), jnp.asarray(rec.labels),
+                          epoch_key, rec.index)
+        else:
+            rp = make_device_replay_step(
+                self.mesh, self.hparams.batch_size, **common
+            )
+
+            def replay(st, rec):
+                return rp(st, self.trn_images, self.trn_labels,
+                          self.data_key, cap.epoch, rec.index)
+
+        wire_true = (
+            self.comms is not None and self.comms.active
+            and self.comms.wire_inline
+        )
+        eager_step = eager_state = reason = None
+        if wire_true:
+            reason = (
+                "wire-true compressed pipeline: the per-device "
+                "error-feedback residual lives in the schedule layout, "
+                "which the eager rail does not model (replay gate still ran)"
+            )
+        else:
+            estep = parity_mod.make_eager_step(
+                precision=self.precision,
+                grad_accum=self.grad_accum,
+                comms=parity_mod.eager_comms_like(self.comms),
+            )
+            # the eager reference forward is the PLAIN model.apply: the
+            # pipeline schedules and sequence rings are layout transforms
+            # around that same math, which is exactly the claim the diff
+            # checks
+            eager_state = parity_mod.eager_state_like(
+                cap.initial, self.model.apply
+            )
+
+            def eager_step(st, rec):
+                return estep(st, rec.images, rec.labels, rec.key)
+
+        layout = {
+            "dp": int(self.mesh.shape.get("data", 1)),
+            "tp": int(self.mesh.shape.get("model", 1)),
+            "pp": int(self.mesh.shape.get("pipe", 1)),
+            "zero": bool(self.shard_optim),
+            "wire": (
+                self.comms.grad_comms
+                if self.comms is not None and self.comms.active else "fp32"
+            ),
+            "schedule": getattr(self.hparams, "pipeline_schedule", None)
+            or "none",
+        }
+        report = parity_mod.run_parity_check(
+            cap,
+            replay_step=replay,
+            place_state=lambda t: place_tree(t, self.state_sharding),
+            eager_step=eager_step,
+            eager_state=eager_state,
+            eager_unsupported_reason=reason,
+            layout=layout,
+        )
+        self.bus.emit("parity", **report)
+        div = report["replay_divergence"] or report["reference_divergence"]
+        if report["verdict"] == "ok":
+            self.logger.info(
+                f"parity: {report['steps']} steps ok under {report['tol']} "
+                f"(replay bitwise, eager {report['eager_reference']}, "
+                f"max ulp {report['max_ulp']})"
+            )
+        else:
+            self.logger.warning(
+                "parity DIVERGENT at step "
+                f"{div['step']} stage={div['stage']} leaf={div['leaf']} "
+                f"(replay={report['replay']}, "
+                f"eager={report['eager_reference']}, tol={report['tol']})"
+            )
+
     def _train_epoch_device(self, epoch: int) -> tuple[np.ndarray, float]:
         """Chunked scanned epoch over the HBM-resident split.
 
@@ -2366,6 +2569,9 @@ class Trainer:
         self._resume_step_offset = 0  # one-shot: only the resumed epoch skips
         self._epoch_step_base = offset
         fault = self._step_fault_for(epoch)
+        cap = self._parity_capture_for(epoch)
+        if cap is not None:
+            self._parity_begin(cap, epoch, offset, "device")
         meter = self._step_meter
         meter.reset()
         epoch_arr = jnp.asarray(epoch)
@@ -2377,6 +2583,8 @@ class Trainer:
         t_epoch = time.perf_counter()
         while done < steps:
             take = min(chunk, steps - done)
+            if cap is not None and cap.capturing:
+                take = 1  # bit-identical by the runner's any-chunking contract
             runner = self._device_runner_for(take)
             args = (
                 self.state,
@@ -2412,6 +2620,18 @@ class Trainer:
             if self._pipe_meta is not None:
                 self._note_pipeline_obs(t_disp, time.monotonic())
             chunk_metrics.append(metrics)  # (take,) device arrays; no sync
+            if cap is not None and cap.capturing and take == 1:
+                self._parity_record(
+                    cap, epoch=epoch, index=done,
+                    images=jax.device_get(
+                        self.trn_images[self._parity_rows[done]]
+                    ),
+                    labels=jax.device_get(
+                        self.trn_labels[self._parity_rows[done]]
+                    ),
+                    key=self._parity_keys[done],
+                    fault=fault, loss=metrics["loss"][0],
+                )
             done += take
             self.metrics.note_steps(take)
             self._obs_tick(epoch=epoch, step=epoch * steps + done)
@@ -2517,6 +2737,9 @@ class Trainer:
         self._epoch_step_base = offset
         steps = self.steps_per_epoch
         fault = self._step_fault_for(epoch)
+        cap = self._parity_capture_for(epoch)
+        if cap is not None:
+            self._parity_begin(cap, epoch, offset, "host")
         meter = self._step_meter
         meter.reset()
         chunk_metrics = []
@@ -2534,6 +2757,9 @@ class Trainer:
                 (s, k, place(b))
                 for s, k, b in chunked_batches(it, steps, chunk, offset)
             )
+        chunk_iter = (
+            chunks if cap is None else self._parity_split_chunks(chunks)
+        )
         bar = self._progress_bar(range(steps), desc=f"epoch {epoch}")
         if bar is not None and offset:
             bar.update(offset)
@@ -2542,7 +2768,12 @@ class Trainer:
         try:
             while done < steps:
                 with meter.phase("h2d_wait"):
-                    start, take, batch = next(chunks)
+                    start, take, batch = next(chunk_iter)
+                recording = cap is not None and cap.capturing and take == 1
+                if recording:
+                    # host copies BEFORE the dispatch donates the buffers
+                    par_x = jax.device_get(batch["x"][0])
+                    par_y = jax.device_get(batch["y"][0])
                 # step boundaries for a --profile-dir capture (see the
                 # device-mode loop)
                 ann = (
@@ -2570,6 +2801,15 @@ class Trainer:
                     self._note_pipeline_obs(t_disp, time.monotonic())
                 del batch  # donated at dispatch; drop the dead references
                 chunk_metrics.append(metrics)  # (take,) device arrays; no sync
+                if recording:
+                    from ..parity import host_step_key
+
+                    self._parity_record(
+                        cap, epoch=epoch, index=start,
+                        images=par_x, labels=par_y,
+                        key=host_step_key(self.data_key, epoch, start),
+                        fault=fault, loss=metrics["loss"][0],
+                    )
                 done = start + take
                 self.metrics.note_steps(take)
                 self._obs_tick(epoch=epoch, step=epoch * steps + done)

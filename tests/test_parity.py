@@ -1,0 +1,366 @@
+"""Eager-parity rail tests (parity/ subsystem, ISSUE 16).
+
+Three layers, mirroring the rail's own architecture:
+
+- **unit** — the diff engine's pure parts: tolerance/corrupt-spec parsing,
+  the scale-aware ulp metric's edge lattice, the leaf-bisection search,
+  the bit-flip injector;
+- **engine** — the bitwise replay-family contract on the 8-device mesh:
+  a K=4 chunked dispatch and four K=1 replay dispatches of the SAME
+  scanned executable family must carry bit-identical state (this is the
+  identity the replay gate's "always bitwise" claim stands on);
+- **trainer** — ``--parity-check`` end to end through ``Trainer.fit``:
+  green captures in both data modes, an injected ``--parity-corrupt``
+  bit flip localized to exactly (step, stage, leaf) by the rendered
+  ``run_report --parity`` view, and the fp16/int8 wire tiers passing
+  under a calibrated ``ulp=K`` while failing under ``bitwise`` — the
+  contrast that proves the tolerance axis measures something real.
+
+The full-Trainer layout sweeps are slow-marked; the unit/engine subset
+and one end-to-end green + one localization run stay in tier-1.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.parallel import (
+    make_mesh,
+    replicated_sharding,
+)
+from distributed_training_comparison_tpu.parity import (
+    Tolerance,
+    checksum_state,
+    corrupt_bitflip,
+    f32_bits,
+    parse_corrupt,
+    ulp_distance,
+)
+from distributed_training_comparison_tpu.parity.diff import (
+    _INT_DIVERGED,
+    _first_divergent_leaf,
+)
+from distributed_training_comparison_tpu.data import synthetic_dataset
+from distributed_training_comparison_tpu.train import (
+    Trainer,
+    configure_optimizers,
+    create_train_state,
+    make_chunk_runner,
+)
+from distributed_training_comparison_tpu.train.step import make_replay_step
+
+from test_train import HP, TinyNet
+
+pytestmark = pytest.mark.parity
+
+
+# ------------------------------------------------------------------ unit
+
+
+def test_tolerance_parse_and_exceeded():
+    assert Tolerance.parse("bitwise").mode == "bitwise"
+    t = Tolerance.parse("ulp=64")
+    assert (t.mode, t.ulp) == ("ulp", 64)
+    assert str(t) == "ulp=64"
+    for bad in ("ulp=", "ulp=-1", "ulp=abc", "exact", ""):
+        with pytest.raises(ValueError):
+            Tolerance.parse(bad)
+    bw = Tolerance.parse("bitwise")
+    assert not bw.exceeded(0.0)
+    assert bw.exceeded(0.5)  # zero-sign/NaN-payload diff: not bit-equal
+    assert not t.exceeded(64.0)
+    assert t.exceeded(64.1)
+    assert t.exceeded(None)  # incomparable shapes always violate
+
+
+def test_parse_corrupt():
+    assert parse_corrupt("3:12:Dense") == (3, 12, "Dense")
+    assert parse_corrupt("0:31:kernel:with:colons") == (
+        0, 31, "kernel:with:colons"
+    )
+    for bad in ("3:32:Dense", "-1:0:Dense", "x:1:Dense", "3:1:", "3", "3:1"):
+        with pytest.raises(ValueError):
+            parse_corrupt(bad)
+
+
+def test_ulp_distance_edge_lattice():
+    one = np.float32([1.0, 2.0])
+    assert ulp_distance(one, one.copy()) == 0.0
+    next_up = one.copy()
+    next_up[0] = np.nextafter(np.float32(1.0), np.float32(2.0))
+    # adjacent representables at half the tensor scale: spacing(1.0) is
+    # half an ulp at scale 2.0, so the scale-aware distance is 0.5
+    assert 0.0 < ulp_distance(one, next_up) <= 1.0
+    # exact bit equality is the ONLY zero: -0.0 vs 0.0 returns 0.5
+    assert ulp_distance(np.float32([0.0]), np.float32([-0.0])) == 0.5
+    # NaN placement mismatch is incomparable-bad
+    assert ulp_distance(np.float32([np.nan]), np.float32([1.0])) == float("inf")
+    # matching NaN placement compares the finite rest
+    assert ulp_distance(
+        np.float32([np.nan, 1.0]), np.float32([np.nan, 1.0])
+    ) == 0.0
+    # inf sign mismatch is incomparable-bad
+    assert ulp_distance(
+        np.float32([np.inf]), np.float32([-np.inf])
+    ) == float("inf")
+    # non-float leaves are exact
+    assert ulp_distance(np.int32([5]), np.int32([5])) == 0.0
+    assert ulp_distance(np.int32([5]), np.int32([6])) == _INT_DIVERGED
+    # incomparable shapes
+    assert ulp_distance(np.zeros(3, np.float32), np.zeros(4, np.float32)) is None
+
+
+def test_ulp_distance_is_scale_aware():
+    """A sign flip at the noise floor must price as sub-ulp noise, not as
+    millions of lexicographic ulps — the dp=8 reduction-order case."""
+    a = np.float32([1.0, 1e-12])
+    b = np.float32([1.0, -1e-12])
+    d = ulp_distance(a, b)
+    assert d is not None and 0 < d < 1.0
+
+
+def test_first_divergent_leaf_bisection():
+    rec = np.arange(10, dtype=np.int64)
+    assert _first_divergent_leaf(rec, rec.copy()) is None
+    rep = rec.copy()
+    rep[7] += 1
+    assert _first_divergent_leaf(rec, rep) == 7
+    rep[3] += 1  # multiple divergent leaves: names the FIRST
+    assert _first_divergent_leaf(rec, rep) == 3
+    rep2 = rec.copy()
+    rep2[0] += 1
+    assert _first_divergent_leaf(rec, rep2) == 0
+    rep3 = rec.copy()
+    rep3[9] += 1
+    assert _first_divergent_leaf(rec, rep3) == 9
+
+
+def test_f32_bits():
+    assert f32_bits(1.0) == 0x3F800000
+    assert f32_bits(np.float32(-0.0)) == 0x80000000
+
+
+def test_corrupt_bitflip_flips_one_bit_of_first_match():
+    state = {
+        "params": {
+            "Conv_0": {"kernel": jnp.ones((3,), jnp.float32)},
+            "Dense_0": {"bias": jnp.full((4,), 2.0, jnp.float32)},
+        }
+    }
+    out, path = corrupt_bitflip(state, "Dense", 31)  # sign bit
+    assert "Dense_0" in path
+    bias = np.asarray(out["params"]["Dense_0"]["bias"])
+    assert bias[0] == -2.0 and np.all(bias[1:] == 2.0)
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["Conv_0"]["kernel"]), 1.0
+    )
+    with pytest.raises(ValueError):
+        corrupt_bitflip(state, "NoSuchLeaf", 0)
+
+
+def test_config_rejects_bad_parity_flags():
+    base = ["--synthetic-data", "--limit-examples", "64", "--batch-size", "8"]
+    with pytest.raises(SystemExit):
+        load_config("ddp", argv=base + ["--parity-check", "2",
+                                        "--parity-tol", "exact"])
+    with pytest.raises(SystemExit):
+        load_config("ddp", argv=base + ["--parity-corrupt", "1:2:Dense"])
+    with pytest.raises(SystemExit):
+        load_config("ddp", argv=base + ["--parity-check", "-1"])
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_replay_family_bitwise_matches_chunked_run():
+    """The replay gate's foundation: one K=4 chunked dispatch and four
+    K=1 dispatches of ``make_replay_step`` (same scanned executable
+    family, ``donate=False``) must produce a bit-identical carried state
+    — the runners' pinned any-chunking contract, observed through the
+    same checksum walk the gate uses."""
+    mesh = make_mesh(backend="ddp")
+    x, y = synthetic_dataset(128, num_classes=10, seed=0)
+    imgs = jnp.asarray(x).reshape(4, 32, *x.shape[1:])
+    lbls = jnp.asarray(y).reshape(4, 32)
+    tx, _ = configure_optimizers(HP, steps_per_epoch=4)
+    state0 = jax.device_put(
+        create_train_state(TinyNet(), jax.random.key(0), tx),
+        replicated_sharding(mesh),
+    )
+    epoch_key = jax.random.fold_in(jax.random.key(1), 0)
+
+    runner = make_chunk_runner(mesh, donate=False)
+    chunked, _ = runner(state0, imgs, lbls, epoch_key, jnp.asarray(0))
+
+    replay = make_replay_step(mesh)
+    s = state0
+    for k in range(4):
+        s, metrics = replay(s, imgs[k], lbls[k], epoch_key, k)
+        assert metrics["loss"].shape == ()
+    np.testing.assert_array_equal(checksum_state(chunked), checksum_state(s))
+
+
+# --------------------------------------------------------------- trainer
+
+
+def _fit_parity(tmp_path, extra, model=None):
+    """One Trainer.fit with the parity rail on; returns the single emitted
+    ``parity`` event payload."""
+    hp = load_config(
+        "ddp",
+        argv=[
+            "--synthetic-data", "--limit-examples", "256",
+            "--batch-size", "64", "--epoch", "1",
+            "--eval-step", "10000", "--lr", "0.05",
+            "--no-progress", "--save-last-min-secs", "0",
+            "--ckpt-path", str(tmp_path),
+            *extra,
+        ],
+    )
+    t = Trainer(hp, model=model if model is not None else TinyNet(num_classes=100))
+    try:
+        t.fit()
+    finally:
+        t.close()
+    payloads = []
+    for p in Path(tmp_path).rglob("events*.jsonl"):
+        for line in p.read_text().splitlines():
+            ev = json.loads(line)
+            if ev.get("kind") == "parity":
+                payloads.append(ev["payload"])
+    assert len(payloads) == 1, f"expected one parity event, got {payloads}"
+    return payloads[0]
+
+
+def test_trainer_parity_host_mode_green(tmp_path):
+    p = _fit_parity(tmp_path, ["--data-mode", "host", "--parity-check", "3"])
+    assert p["steps"] == 3 and p["mode"] == "host"
+    assert p["replay"] == "ok" and p["replay_divergence"] is None
+    assert p["eager_reference"] == "ok" and p["reference_divergence"] is None
+    assert p["verdict"] == "ok"
+    assert p["max_ulp"] <= 1024  # the calibrated dp-fp32 band
+    assert p["layout"]["dp"] == 8 and not p["layout"]["zero"]
+
+    import run_report
+
+    assert run_report.parity_report(tmp_path, out=lambda s: None) == 0
+
+
+def test_trainer_parity_corruption_localized(tmp_path):
+    """The acceptance criterion: a single injected bit flip must come back
+    from ``run_report --parity`` as exactly (step, stage, leaf)."""
+    p = _fit_parity(
+        tmp_path,
+        ["--data-mode", "host", "--parity-check", "3",
+         "--parity-corrupt", "1:6:Dense"],
+    )
+    assert p["verdict"] == "divergent" and p["replay"] == "divergent"
+    rdiv = p["replay_divergence"]
+    assert rdiv["step"] == 1
+    assert rdiv["stage"] == "relayout"  # a params leaf: the final apply
+    assert "Dense" in rdiv["leaf"]
+    assert rdiv["divergent_leaves"] == 1
+    assert p["corrupt"]["step"] == 1 and p["corrupt"]["bit"] == 6
+    # the eager reference tracks the CLEAN replay, and a low mantissa bit
+    # sits inside the fp32 fusion band — only the bitwise gate can see it
+    assert p["eager_reference"] == "ok"
+
+    import run_report
+
+    lines = []
+    assert run_report.parity_report(tmp_path, out=lines.append) == 1
+    text = "\n".join(str(l) for l in lines)
+    assert "DIVERGENT at step 1" in text
+    assert "relayout X" in text  # the rendered bisection trail
+    assert "Dense" in text
+
+
+def test_run_report_parity_return_codes(tmp_path):
+    import run_report
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert run_report.parity_report(empty, out=lambda s: None) == 2
+    no_parity = tmp_path / "plain"
+    no_parity.mkdir()
+    (no_parity / "events.jsonl").write_text(
+        json.dumps({"v": 1, "kind": "epoch_end", "t_wall": 0.0,
+                    "epoch": 0, "payload": {"train_loss": 1.0}}) + "\n"
+    )
+    assert run_report.parity_report(no_parity, out=lambda s: None) == 0
+
+
+@pytest.mark.slow
+def test_trainer_parity_device_mode_green(tmp_path):
+    p = _fit_parity(tmp_path, ["--data-mode", "device", "--parity-check", "3"])
+    assert p["mode"] == "device" and p["verdict"] == "ok"
+    assert p["replay"] == "ok" and p["eager_reference"] == "ok"
+    assert p["max_ulp"] <= 1024
+
+
+@pytest.mark.slow
+def test_trainer_parity_fp16_wire_contrast(tmp_path):
+    """The wire-tier contrast: the SAME fp16 capture passes under its
+    calibrated ulp tolerance and fails under ``bitwise`` — the replay
+    gate stays green both times (compression is deterministic; only the
+    eager-vs-compiled quantize boundary reassociates)."""
+    loose = _fit_parity(
+        tmp_path / "loose",
+        ["--data-mode", "host", "--parity-check", "3",
+         "--grad-comms", "fp16", "--parity-tol", f"ulp={1 << 27}"],
+    )
+    assert loose["verdict"] == "ok" and loose["replay"] == "ok"
+    assert loose["max_ulp"] > 1024  # quantize buckets flip: far off fp32 band
+    assert loose["layout"]["wire"] == "fp16"
+
+    strict = _fit_parity(
+        tmp_path / "strict",
+        ["--data-mode", "host", "--parity-check", "3",
+         "--grad-comms", "fp16", "--parity-tol", "bitwise"],
+    )
+    assert strict["replay"] == "ok"  # bitwise replay is tol-independent
+    assert strict["eager_reference"] == "divergent"
+    assert strict["verdict"] == "divergent"
+    assert strict["reference_divergence"]["ulp"] is not None
+
+
+@pytest.mark.slow
+def test_trainer_parity_int8_wire_under_calibrated_ulp(tmp_path):
+    p = _fit_parity(
+        tmp_path,
+        ["--data-mode", "host", "--parity-check", "3",
+         "--grad-comms", "int8", "--parity-tol", f"ulp={1 << 27}"],
+    )
+    assert p["verdict"] == "ok" and p["replay"] == "ok"
+    assert p["max_ulp"] > 10  # real quantize noise, not a vacuous pass
+    assert p["layout"]["wire"] == "int8"
+
+
+@pytest.mark.slow
+def test_trainer_parity_wire_true_pipeline_reference_unsupported(tmp_path):
+    """The documented hole: the wire-true compressed pipeline keeps its
+    error-feedback residual inside the schedule, which the eager rail
+    does not model — the reference gate must say so explicitly while the
+    bitwise replay gate still runs (and stays green)."""
+    from distributed_training_comparison_tpu.models.vit import ViT
+
+    p = _fit_parity(
+        tmp_path,
+        ["--data-mode", "device", "--parity-check", "2",
+         "--model-parallel", "2", "--parallel-style", "pipeline",
+         "--pipeline-schedule", "1f1b",
+         "--pipeline-microbatches", "2", "--grad-comms", "fp16"],
+        model=ViT(depth=8, dim=32, heads=2, patch=8),
+    )
+    assert p["replay"] == "ok"
+    assert p["eager_reference"] == "unsupported"
+    assert "wire" in p["eager_reference_reason"].lower()
+    assert p["verdict"] == "ok"  # an unsupported reference is not a failure

@@ -1190,6 +1190,121 @@ def plan_report(path: str | Path, out=print) -> int:
     return rc
 
 
+# the parity rail's transform-pipeline order (parity/diff.py STAGES) — the
+# bisection trail renders the stages before the first divergent one as clean
+_PARITY_STAGES = ("grads", "wire", "optimizer", "relayout")
+
+
+def _parity_trail(div: dict) -> str:
+    """Render one gate's bisection trail: the stage ladder with the first
+    divergent stage marked, then the named leaf and its distance."""
+    stage = div.get("stage")
+    marks = []
+    for s in _PARITY_STAGES:
+        if s == stage:
+            marks.append(f"{s} X")
+            break
+        marks.append(f"{s} ok")
+    return " -> ".join(marks)
+
+
+def parity_report(path: str | Path, out=print) -> int:
+    """The ``--parity`` view: every completed ``--parity-check`` capture
+    under ``path`` — both gate verdicts, the bisection trail down to the
+    first divergent (step, stage, leaf, distance), and the layout under
+    test.
+
+    Exit 0 when every parity event's verdict is ``ok``; 1 on any
+    divergence (either gate — a bitwise replay mismatch is corruption or
+    nondeterminism, a reference-gate trip means the compiled layout left
+    the eager semantics beyond the priced tolerance); 2 when ``path``
+    holds no events at all.  A stream with events but no ``parity`` kind
+    exits 0 with a note (the run didn't ask for the rail)."""
+    events, _files = load_run(path)
+    if not events:
+        out(f"{path}: no events found")
+        return 2
+    parities = [ev for ev in events if ev.get("kind") == "parity"]
+    if not parities:
+        out(f"{path}: no parity events (run without --parity-check N)")
+        return 0
+    rc = 0
+    t0 = events[0].get("t_wall", 0.0)
+    for ev in parities:
+        p = _payload(ev)
+        layout = p.get("layout") or {}
+        lay = (
+            f"dp{layout.get('dp', '?')}*tp{layout.get('tp', '?')}"
+            f"*pp{layout.get('pp', '?')} zero="
+            f"{'on' if layout.get('zero') else 'off'} "
+            f"wire={layout.get('wire', '?')} "
+            f"sched={layout.get('schedule', 'none')}"
+        )
+        out(
+            f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s] PARITY epoch "
+            f"{p.get('epoch', ev.get('epoch', '?'))}: {p.get('steps', '?')} step(s), "
+            f"{p.get('mode', '?')} mode, tol {p.get('tol', '?')}, {lay}"
+        )
+        if p.get("corrupt"):
+            c = p["corrupt"]
+            out(
+                f"    injected corruption: bit {c.get('bit')} of "
+                f"{c.get('leaf')} after step {c.get('step')} "
+                "(--parity-corrupt)"
+            )
+        rdiv = p.get("replay_divergence")
+        if rdiv is None:
+            out("    replay gate:    ok (bitwise, "
+                f"{p.get('steps', '?')} step(s) replayed)")
+        else:
+            rc = 1
+            out(f"    replay gate:    DIVERGENT at step {rdiv.get('step')}")
+            out(f"      trail: {_parity_trail(rdiv)}")
+            out(
+                f"      first leaf {rdiv.get('leaf')} "
+                f"[{rdiv.get('divergent_leaves')} divergent leaf/leaves]: "
+                f"recorded checksum {rdiv.get('recorded_checksum')} vs "
+                f"replay {rdiv.get('replay_checksum')}"
+            )
+            if rdiv.get("loss_bits_recorded") != rdiv.get("loss_bits_replay"):
+                out(
+                    f"      loss bits recorded {rdiv.get('loss_bits_recorded')}"
+                    f" vs replay {rdiv.get('loss_bits_replay')}"
+                    + (
+                        f" (recorded fault scale x{rdiv.get('fault_scale')})"
+                        if rdiv.get("fault_scale", 1.0) != 1.0 else ""
+                    )
+                )
+        ref = p.get("eager_reference")
+        if ref == "unsupported":
+            out(
+                "    reference gate: unsupported — "
+                f"{p.get('eager_reference_reason', 'not modeled')}"
+            )
+        elif p.get("reference_divergence") is None:
+            out(
+                f"    reference gate: ok (max {p.get('max_ulp', 0)} "
+                f"scale-aware ulp <= {p.get('tol')})"
+            )
+        else:
+            rc = 1
+            fdiv = p["reference_divergence"]
+            out(f"    reference gate: DIVERGENT at step {fdiv.get('step')}")
+            out(f"      trail: {_parity_trail(fdiv)}")
+            out(
+                f"      first leaf {fdiv.get('leaf')} "
+                f"[{fdiv.get('divergent_leaves')} divergent leaf/leaves]: "
+                f"{fdiv.get('ulp')} scale-aware ulp vs tol {p.get('tol')} "
+                f"(loss ulp {fdiv.get('loss_ulp')})"
+            )
+    if rc:
+        out("parity DIVERGED: the compiled trajectory left its recorded/"
+            "eager reference (see the trail above)")
+    else:
+        out(f"all {len(parities)} parity capture(s) clean")
+    return rc
+
+
 def export_openmetrics(path: str | Path, out_path: str | None = None) -> str:
     """The scrape-less exposition: fold a finished (or in-flight) run's
     ``metrics`` events — plus the serve records' latency deltas — into
@@ -1646,6 +1761,13 @@ def main(argv: list[str]) -> int:
         "ignored plan must fail the stream check",
     )
     ap.add_argument(
+        "--parity", action="store_true",
+        help="print the eager-parity captures (parity/: bitwise replay "
+        "gate + tolerance-gated eager reference gate) with the bisection "
+        "trail down to the first divergent (step, stage, leaf, ulp); "
+        "exit 1 on any divergence — the parity bench leg's gate",
+    )
+    ap.add_argument(
         "--serve", action="store_true",
         help="print the per-SLO-class serving attainment table "
         "reconstructed from the serve_route events alone (+ the "
@@ -1700,6 +1822,12 @@ def main(argv: list[str]) -> int:
         rc = 0
         for path in args.paths:
             rc = max(rc, plan_report(path))
+        return rc
+
+    if args.parity:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, parity_report(path))
         return rc
 
     if args.serve:
