@@ -1004,6 +1004,380 @@ def bench_serve(out_path: str = "BENCH_SERVE.json") -> dict:
     return record
 
 
+def bench_serve_fleet(out_path: str = "BENCH_SERVE_FLEET.json") -> dict:
+    """The PROCESS fleet's scoreboard (``--serve-fleet``): every replica
+    a real OS process behind the socket transport (serve/fleet/).
+
+    Five legs, one committed JSON capture:
+
+    1-3. **fleet capacity at 1/2/4 process replicas** — closed-loop
+       saturation through the router's dispatcher threads.  On this
+       CPU host the replicas still share one core set, so the speedup
+       that CAN appear is pipelining: replica B's compute overlaps the
+       router-side gaps (batch assembly, socket round-trip, future
+       resolution) that leave a single worker idle between dispatches.
+       The thread-transport baseline (BENCH_SERVE.json router leg) had
+       NO such overlap to claim — its 2-replica ratio sat below 1.
+    4. **scale up/down** — a flash then a trickle through the live
+       autoscaler: the G/G/m sizing must grow the fleet under the
+       flash and drain it back on the trickle, both directions visible
+       as applied ``serve_scale`` events, with ``run_report --serve``'s
+       scale/fleet agreement gate as the leg's self-check.
+    5. **replica kill** — SIGKILL one worker mid-backlog: the in-flight
+       batch requeues, the supervisor relaunches from the shared
+       persisted AOT store, and every admitted request completes (zero
+       ``failed``).
+
+    Weights are fresh-initialized; sized down so the capture reproduces
+    on the CI host.  Each leg gets its own event root + fleet dir; the
+    AOT store is shared capture-wide so later spawns warm-start.
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from distributed_training_comparison_tpu import obs
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.serve import (
+        ServeRouter,
+        closed_loop,
+        open_loop,
+        request_pool,
+    )
+    from distributed_training_comparison_tpu.serve.fleet import (
+        Autoscaler,
+        parse_scale_targets,
+        worker_hparams_dict,
+    )
+
+    platform = jax.devices()[0].platform
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # small images + a short ladder ON PURPOSE: the per-dispatch compute
+    # must be small enough that the router-side overhead a second
+    # process replica can hide (assembly/socket/resolve) is a visible
+    # fraction of the cycle — at 224px the capture would only restate
+    # "compute dominates"
+    model_name, image_size = "resnet18", 16
+    buckets = (1, 4)
+    fleet_requests, fleet_conc, fleet_reps = 192, 16, 3
+    kill_requests = 240
+
+    root = tempfile.mkdtemp(prefix="serve-fleet-bench-")
+    aot_dir = os.path.join(root, "serve-aot")
+    legs: dict = {}
+
+    def leg(key, fn):
+        try:
+            legs[key] = fn()
+        except Exception as e:  # evidence over abort, like run_legs
+            legs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        emit_progress(key, legs[key])
+        return legs[key]
+
+    def leg_setup(name):
+        leg_root = os.path.join(root, name)
+        os.makedirs(leg_root, exist_ok=True)
+        bus = obs.configure(run_id=obs.new_run_id())
+        bus.bind_dir(leg_root)
+        hp = load_config("single", argv=[
+            "--model", model_name, "--image-size", str(image_size),
+            "--serve-buckets", ",".join(str(b) for b in buckets),
+            "--seed", "3", "--ckpt-path", leg_root,
+        ])
+        spec = {
+            "fleet_dir": os.path.join(leg_root, "serve-fleet"),
+            "events_dir": leg_root,
+            "hparams": worker_hparams_dict(hp),
+            "port_base": 0,  # ephemeral; the handshake reports the port
+            "metrics_port_base": 0,
+            "platform": platform,
+            "run_id": bus.run_id,
+            "attempt": 0,
+            "aot_dir": aot_dir,
+        }
+        return leg_root, bus, spec
+
+    def lat(rep):
+        return {
+            "throughput_rps": rep["throughput_rps"],
+            "p50_ms": rep["latency_ms"]["p50"],
+            "p99_ms": rep["latency_ms"]["p99"],
+        }
+
+    # ---- legs 1-3: capacity at 1/2/4 process replicas -----------------
+    def capacity_leg(n):
+        def run():
+            leg_root, bus, spec = leg_setup(f"fleet_{n}")
+            # per-leg request-pool fold: sibling legs must not replay
+            # byte-identical pools (the exporter-collision satellite's
+            # decorrelation path, exercised where it matters)
+            pool = request_pool(
+                256, image_size=image_size, seed=0, fold=("fleet", n)
+            )
+            r = ServeRouter(
+                None, replicas=n, transport="process", process_spec=spec,
+                bus=bus, queue_limit=1024, emit_every_s=2.0,
+            )
+            try:
+                if not r.wait_ready(n=n, timeout=900):
+                    raise RuntimeError(f"{n}-replica fleet never went ready")
+                reps = [
+                    closed_loop(
+                        r, pool, num_requests=fleet_requests,
+                        concurrency=fleet_conc,
+                    )
+                    for _ in range(fleet_reps)
+                ]
+            finally:
+                r.close()
+            obs.reset(bus)
+            med = sorted(
+                reps, key=lambda x: x["throughput_rps"]
+            )[len(reps) // 2]
+            return {
+                "replicas": n,
+                "median": lat(med),
+                "reps": [lat(x) for x in reps],
+                "events_check_rc": events_check_rc(
+                    leg_root, require_kinds=("replica", "serve_route")
+                ),
+            }
+        return run
+
+    f1 = leg("fleet_1", capacity_leg(1))
+    f2 = leg("fleet_2", capacity_leg(2))
+    f4 = leg("fleet_4", capacity_leg(4))
+    summary = None
+    if all("error" not in x for x in (f1, f2, f4)):
+        rps1 = f1["median"]["throughput_rps"]
+        summary = {
+            "throughput_rps": {
+                1: rps1,
+                2: f2["median"]["throughput_rps"],
+                4: f4["median"]["throughput_rps"],
+            },
+            "process_scale_ratio_2v1": round(
+                f2["median"]["throughput_rps"] / max(1e-9, rps1), 3
+            ),
+            "process_scale_ratio_4v1": round(
+                f4["median"]["throughput_rps"] / max(1e-9, rps1), 3
+            ),
+            "thread_baseline_2v1": _thread_baseline_ratio(repo),
+        }
+
+    # ---- leg 4: autoscaler up AND down on live traffic ----------------
+    def scale_leg():
+        leg_root, bus, spec = leg_setup("scale_up_down")
+        pool = request_pool(
+            256, image_size=image_size, seed=0, fold=("fleet", "scale")
+        )
+        r = ServeRouter(
+            None, replicas=1, transport="process", process_spec=spec,
+            bus=bus, queue_limit=4096, emit_every_s=1.0,
+        )
+        # target 2000ms, NOT a tight one: on this 1-core host the
+        # flash-era service p99 is contention-inflated (workers + router
+        # share the core), and service sketches are session-cumulative —
+        # a tight target would read that noise as "m=1 can never hold"
+        # and refuse to scale down.  The flash still forces scale-up
+        # through saturation (rho >= 1 -> predicted tail = inf at m=1)
+        # at ANY finite target, so both directions stay honest.
+        scaler = Autoscaler(
+            r.metrics, parse_scale_targets("p99=2000"),
+            min_replicas=1, max_replicas=2,
+            window_s=6.0, cooldown_s=3.0, hold=2, bus=bus,
+        )
+        r.attach_autoscaler(scaler)
+        r._scale_every_s = 0.5  # capture-speed ticks, same math
+        rps1 = (
+            (legs.get("fleet_1") or {}).get("median") or {}
+        ).get("throughput_rps") or 8.0
+        try:
+            if not r.wait_ready(n=1, timeout=900):
+                raise RuntimeError("scale leg's first replica not ready")
+            # flash well past one replica's measured capacity: the
+            # G/G/m fit saturates and the scaler must grow the fleet
+            flash_rate = max(8.0, 2.5 * rps1)
+            flash = open_loop(
+                r, pool, rate_rps=flash_rate,
+                num_requests=int(flash_rate * 8), seed=1,
+            )
+            # trickle until the 6s arrival window forgets the flash and
+            # the scaler drains back down (bounded: 4 bursts)
+            trickles = []
+            for burst in range(4):
+                trickles.append(open_loop(
+                    r, pool, rate_rps=2.0, num_requests=24,
+                    seed=2 + burst,
+                ))
+                if r.active_replicas() == 1:
+                    break
+            scaled_down_live = r.active_replicas() == 1
+        finally:
+            r.close()
+        obs.reset(bus)
+        scale_events = [
+            (e.get("payload") or {})
+            for e in obs.load_events(os.path.join(leg_root, "events.jsonl"))
+            if e.get("kind") == "serve_scale"
+        ]
+        ups = [
+            p for p in scale_events
+            if p.get("scale_state", p.get("state")) == "applied"
+            and p.get("added")
+        ]
+        downs = [
+            p for p in scale_events
+            if p.get("scale_state", p.get("state")) == "applied"
+            and p.get("drained")
+        ]
+        out = {
+            "flash": lat(flash),
+            "trickle_bursts": len(trickles),
+            "scaled_down_live": scaled_down_live,
+            "scale_up_applied": len(ups),
+            "scale_down_applied": len(downs),
+            "sized_by": sorted({
+                p.get("sized_by") for p in ups + downs if p.get("sized_by")
+            }),
+            "events_check_rc": events_check_rc(
+                leg_root,
+                require_kinds=("replica", "serve_route", "serve_scale"),
+            ),
+            # the satellite gate: scale decisions and replica lifecycles
+            # must AGREE on the stream run_report --serve reconstructs
+            "run_report_serve_rc": subprocess.run(
+                [sys.executable,
+                 os.path.join(repo, "tools", "run_report.py"),
+                 leg_root, "--serve"],
+            ).returncode,
+        }
+        if not ups or not downs:
+            raise RuntimeError(
+                f"autoscaler evidence incomplete: {len(ups)} scale-up / "
+                f"{len(downs)} scale-down applied events "
+                f"(states seen: {sorted({p.get('state') for p in scale_events})})"
+            )
+        return out
+
+    leg("scale_up_down", scale_leg)
+
+    # ---- leg 5: SIGKILL a worker mid-backlog --------------------------
+    def kill_leg():
+        leg_root, bus, spec = leg_setup("replica_kill")
+        pool = request_pool(
+            256, image_size=image_size, seed=0, fold=("fleet", "kill")
+        )
+        r = ServeRouter(
+            None, replicas=2, transport="process", process_spec=spec,
+            bus=bus, queue_limit=1024, emit_every_s=1.0,
+        )
+        try:
+            if not r.wait_ready(n=2, timeout=900):
+                raise RuntimeError("kill leg's fleet never went ready")
+            victim = r.replicas[0]
+            pid = victim.pid
+            futs = [
+                r.submit(pool[i % len(pool)]) for i in range(kill_requests)
+            ]
+            deadline = time.monotonic() + 120
+            while victim.dispatches < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            os.kill(pid, signal.SIGKILL)
+            rows = [f.result(timeout=600) for f in futs]
+            completed = len(rows)
+            restarts = victim.restarts
+            failed = r.metrics.failed
+            shed = r.metrics.shed
+            expired = r.metrics.expired
+        finally:
+            r.close()
+        obs.reset(bus)
+        out = {
+            "requests": kill_requests,
+            "completed": completed,
+            "failed": failed,
+            "shed": shed,
+            "expired": expired,
+            "supervisor_restarts": restarts,
+            "events_check_rc": events_check_rc(
+                leg_root, require_kinds=("replica", "serve_route")
+            ),
+        }
+        if failed or completed != kill_requests:
+            raise RuntimeError(
+                f"replica kill dropped work: {completed}/{kill_requests} "
+                f"completed, {failed} failed"
+            )
+        return out
+
+    leg("replica_kill", kill_leg)
+
+    check_rcs = [
+        v.get("events_check_rc") for v in legs.values() if isinstance(v, dict)
+    ]
+    all_checks_ok = bool(check_rcs) and all(rc == 0 for rc in check_rcs)
+    record = {
+        "metric": "cifar100_resnet18_serve_fleet",
+        "version": 1,
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "model": model_name,
+        "image_size": image_size,
+        "buckets": list(buckets),
+        "closed_concurrency": fleet_conc,
+        "requests_per_rep": fleet_requests,
+        "reps_per_fleet_size": fleet_reps,
+        "fleet_capacity": summary,
+        "all_events_checks_ok": all_checks_ok,
+        "legs": legs,
+        "note": (
+            "CPU capture, one shared core set: the 2v1 ratio's MAGNITUDE "
+            "is not the paper's accelerator claim — what binds is the "
+            "ORDERING (process replicas pipeline the router-side gaps a "
+            "single worker idles through, so 2v1 > 1 where the thread "
+            "transport's baseline sat below 1) plus the zero-loss kill "
+            "leg and both autoscale directions on live traffic.  "
+            "Absolute latencies are 1-core service times at 16px."
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({
+        "metric": record["metric"],
+        "platform": platform,
+        "fleet_capacity": summary,
+        "scale_up_down": {
+            k: (legs.get("scale_up_down") or {}).get(k)
+            for k in ("scale_up_applied", "scale_down_applied",
+                      "run_report_serve_rc", "error")
+        },
+        "replica_kill": {
+            k: (legs.get("replica_kill") or {}).get(k)
+            for k in ("completed", "failed", "supervisor_restarts", "error")
+        },
+        "all_events_checks_ok": all_checks_ok,
+        "full_record": out_path,
+    }))
+    return record
+
+
+def _thread_baseline_ratio(repo):
+    """The thread transport's 2-replica ratio from the committed
+    BENCH_SERVE.json — the number this capture's process ratio is read
+    against (None when the baseline capture is absent)."""
+    import os
+
+    try:
+        with open(os.path.join(repo, "BENCH_SERVE.json")) as f:
+            return ((json.load(f).get("router_scale_out") or {})
+                    .get("scale_out_rps_ratio"))
+    except (OSError, ValueError):
+        return None
+
+
 def _bench_serve_cold_child(argv) -> None:
     """One REAL fresh serving process for the cold-start leg: build the
     engine against the given persisted AOT store, warm the ladder, serve
@@ -1306,6 +1680,55 @@ def _run_serve_chaos_scenario(name: str, sc: dict, repo: str, run_report):
         cmd, cwd=repo, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
+    # chaos driver "kill_replica": watch the fleet's handshake files
+    # until every process replica reports ready, give the load shape a
+    # moment to start flowing, then SIGKILL replica 0's worker — rid 0
+    # because LIFO scale-down drains the HIGHEST rid, so an autoscaler
+    # riding along can never have politely drained our victim first.
+    kill_info = {"kills": 0}
+    if sc.get("driver") == "kill_replica":
+        import signal
+        import threading
+
+        xargs = list(sc["extra_args"])
+        want = (
+            int(xargs[xargs.index("--serve-replicas") + 1])
+            if "--serve-replicas" in xargs
+            else 1
+        )
+
+        def _kill_driver():
+            fleet = os.path.join(root, "serve-fleet")
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline and proc.poll() is None:
+                ready = {}
+                for fn in sorted(os.listdir(fleet)) if os.path.isdir(
+                    fleet
+                ) else []:
+                    if (
+                        not fn.startswith("replica-")
+                        or not fn.endswith(".json")
+                        or ".spec." in fn
+                    ):
+                        continue
+                    try:
+                        with open(os.path.join(fleet, fn)) as fh:
+                            hs = json.load(fh)
+                    except (OSError, ValueError):
+                        continue  # mid-write handshake; next poll has it
+                    if hs.get("state") == "ready" and hs.get("pid"):
+                        ready[fn] = int(hs["pid"])
+                if len(ready) >= want:
+                    time.sleep(2.0)
+                    try:
+                        os.kill(ready[min(ready)], signal.SIGKILL)
+                        kill_info["kills"] += 1
+                    except OSError:
+                        pass
+                    return
+                time.sleep(0.25)
+
+        threading.Thread(target=_kill_driver, daemon=True).start()
     try:
         out, err = proc.communicate(timeout=900)
     except subprocess.TimeoutExpired:
@@ -1316,6 +1739,8 @@ def _run_serve_chaos_scenario(name: str, sc: dict, repo: str, run_report):
     events, _files = run_report.load_run(root)
     policy_states: dict[str, int] = {}
     recompiles = 0
+    restarts = 0
+    failed_requests = None
     phases = None
     for ev in events:
         kind = ev.get("kind")
@@ -1325,8 +1750,17 @@ def _run_serve_chaos_scenario(name: str, sc: dict, repo: str, run_report):
             policy_states[st] = policy_states.get(st, 0) + 1
         elif kind == "compile" and p.get("recompile_after_warmup"):
             recompiles += 1
-        elif kind == "serve" and p.get("phases"):
-            phases = p["phases"]
+        elif kind == "replica" and (
+            p.get("lifecycle") == "attempt_start" and p.get("attempt")
+        ):
+            # attempt >= 1 on a replica lifecycle event IS a supervisor
+            # restart (attempt 0 is the original launch)
+            restarts += 1
+        elif kind == "serve":
+            if p.get("phases"):
+                phases = p["phases"]
+            if p.get("failed") is not None:
+                failed_requests = p["failed"]
     # recovery is judged against the WORST phase (the storm may land a
     # burst early under Poisson arrivals): the final phase's p99 must sit
     # below the cliff, wherever the cliff was — and the after phase must
@@ -1352,7 +1786,9 @@ def _run_serve_chaos_scenario(name: str, sc: dict, repo: str, run_report):
             if ev.get("kind") == "alert"
             and (ev.get("payload") or {}).get("state") == "firing"
         ),
-        "restarts": 0, "preemptions": 0,
+        "restarts": restarts, "preemptions": 0,
+        "kills": kill_info["kills"],
+        "failed_requests": failed_requests,
         "policy_requested": policy_states.get("requested", 0),
         "policy_completed": policy_states.get("completed", 0),
         "policy_failed": policy_states.get("failed", 0),
@@ -1383,7 +1819,7 @@ def _run_serve_chaos_scenario(name: str, sc: dict, repo: str, run_report):
         "alerts": list(sc["alerts"]),
         "policies": list(sc["policies"]),
         "policy_mode": sc["policy_mode"],
-        "driver": [],
+        "driver": [sc["driver"]] if sc.get("driver") else [],
         **observed,
         "events_check_rc": check_rc,
         "green": not problems,
@@ -3500,6 +3936,8 @@ if __name__ == "__main__":
         _bench_serve_cold_child(
             sys.argv[sys.argv.index("--serve-cold-child") + 1:]
         )
+    elif "--serve-fleet" in sys.argv:
+        bench_serve_fleet()
     elif "--serve" in sys.argv:
         bench_serve()
     elif "--resilience" in sys.argv:

@@ -524,6 +524,46 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "compile ledger under --ckpt-path and the offered --serve-rate",
     )
     parser.add_argument(
+        "--serve-transport",
+        type=str,
+        default="thread",
+        choices=("thread", "process"),
+        help="Replica substrate: 'thread' (N engines in this process "
+        "sharing one jax runtime — the fast in-test default) or "
+        "'process' (serve/fleet/: each replica is a real OS process "
+        "with its own jax runtime, device set, and exporter port, "
+        "reached over the length-prefixed socket transport, supervised "
+        "with restart budget + backoff; a worker that dies mid-dispatch "
+        "gets its batch requeued, not failed)",
+    )
+    parser.add_argument(
+        "--serve-scale-target",
+        type=str,
+        default="",
+        help="Queueing-aware autoscaling targets (serve/fleet/"
+        "autoscale.py): '[CLASS:]p99=MILLIS[,...]' — fit a G/G/m tail "
+        "from the measured service/arrival sketches and re-size the "
+        "fleet to the smallest replica count whose predicted p99 meets "
+        "every target (scale-up immediate, scale-down hysteretic, both "
+        "behind a cooldown, every decision a serve_scale event).  "
+        "Empty = fixed fleet.  E.g. 'p99=400' or 'gold:p99=150'",
+    )
+    parser.add_argument(
+        "--serve-port-base",
+        type=int,
+        default=0,
+        help="Process-transport request-port base: replica RID listens "
+        "on base+RID (deterministic, so N same-host workers never "
+        "collide).  0 = each worker binds an ephemeral port and reports "
+        "it through its handshake file",
+    )
+    parser.add_argument(
+        "--serve-max-replicas",
+        type=int,
+        default=8,
+        help="Autoscaler fleet-size ceiling (and plan_serve's clamp)",
+    )
+    parser.add_argument(
         "--serve-classes",
         type=str,
         default="",
@@ -1196,4 +1236,22 @@ def load_config(
             parse_slo_classes(args.serve_classes)
         except SLOClassError as e:
             parser.error(str(e))
+    if args.serve_scale_target:
+        # same contract: a malformed autoscale target dies at the CLI
+        from .serve.fleet.autoscale import parse_scale_targets
+
+        try:
+            parse_scale_targets(args.serve_scale_target)
+        except ValueError as e:
+            parser.error(str(e))
+    if args.serve_port_base < 0 or args.serve_port_base > 65535:
+        parser.error(
+            f"--serve-port-base must be in [0, 65535], got "
+            f"{args.serve_port_base}"
+        )
+    if args.serve_max_replicas < 1:
+        parser.error(
+            f"--serve-max-replicas must be >= 1, got "
+            f"{args.serve_max_replicas}"
+        )
     return args

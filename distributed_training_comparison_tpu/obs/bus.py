@@ -103,6 +103,12 @@ KNOWN_KINDS = {
     # installed capacity plan — the stream-only input of
     # `run_report --serve`'s attainment gate
     "replica", "serve_route",
+    # queueing-aware autoscaler (serve/fleet/autoscale): one event per
+    # sizing decision — proposed vs current fleet, the G/G/m fit inputs
+    # (λ, ca², service sketch) and per-class predicted-vs-target p99
+    # rows, whether it applied, held (cooldown / scale-down hysteresis),
+    # or was forced by the `scale_serve` autopilot action
+    "serve_scale",
     # eager-parity debug rail (parity/): one event per completed
     # --parity-check capture — both gate verdicts (bitwise replay vs the
     # recorded trajectory, tolerance-gated eager reference), the first

@@ -95,7 +95,7 @@ POLICY_KIND = "policy"
 
 ACTIONS = (
     "drain_host", "rewarm_serve", "rollback", "abort_with_evidence",
-    "replan",
+    "replan", "scale_serve",
 )
 MODES = ("off", "dry-run", "act")
 DEFAULT_COOLDOWN_S = 60.0
@@ -674,18 +674,43 @@ def supervisor_actions(
 # ---------------------------------------------------- serving executors
 
 
-def serve_actions(router) -> dict:
+def serve_actions(router, autoscaler=None) -> dict:
     """The serving-process executor set: ``rewarm_serve`` targets the
     whole replica fleet — every ready replica re-runs ``warmup()`` on
     its affected bucket subset (``ServeRouter.rewarm``; a single-engine
     session passes a one-replica router) and the per-replica report
     lands in the ``completed`` policy event, so the stream shows WHICH
-    replicas re-warmed WHAT."""
+    replicas re-warmed WHAT.
+
+    ``scale_serve`` binds only when the session carries a queueing-aware
+    autoscaler (``--serve-scale-target``): one FORCED sizing step —
+    same G/G/m math as the live loop, but skipping its cooldown and
+    scale-down hysteresis (the policy engine's own cooldown/budget rail
+    the action instead).  Without an autoscaler the action stays
+    unbound and a rule naming it records the ``unbound`` decision
+    state, like every other executor-less action."""
 
     def rewarm_serve(decision: dict) -> dict:
         return router.rewarm()
 
-    return {"rewarm_serve": rewarm_serve}
+    out = {"rewarm_serve": rewarm_serve}
+
+    if autoscaler is not None:
+        def scale_serve(decision: dict) -> dict:
+            step = autoscaler.step(router, force=True)
+            out = {
+                k: step.get(k)
+                for k in ("current", "proposed", "sized_by",
+                          "lam_rps", "added", "drained")
+                if k in step
+            }
+            # the sizing verdict, renamed: "state" is the policy
+            # event's own lifecycle field
+            out["scale_state"] = step.get("state")
+            return out
+
+        out["scale_serve"] = scale_serve
+    return out
 
 
 # ------------------------------------------------- offline (run_report)

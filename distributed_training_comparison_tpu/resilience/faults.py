@@ -542,6 +542,41 @@ CHAOS_SCENARIOS: dict[str, dict] = {
         },
         "require_kinds": ("serve", "serve_route", "policy", "compile"),
     },
+    "serve_replica_kill_flash": {
+        "desc": "SIGKILL a process replica mid-load -> in-flight batch "
+                "requeues (zero failed requests), the supervisor "
+                "relaunches the worker inside its restart budget, and "
+                "the post-flash p99 recovers on the survivor + the "
+                "warm-started incarnation",
+        # process transport (serve/fleet/): each replica is a real OS
+        # process behind the socket transport, so the kill is a true
+        # worker death — the chaos driver (bench.py) watches the
+        # handshake files and SIGKILLs replica 0 once the fleet is
+        # ready and load is flowing.  The autoscaler rides along
+        # (--serve-scale-target) so the scenario also proves scaling
+        # decisions keep flowing through a replica death.
+        "session": "serve",
+        "fault_plan": None,
+        "alerts": (),
+        "policies": (),
+        "policy_mode": "act",
+        "driver": "kill_replica",
+        "env": {},
+        "extra_args": (
+            "--serve", "--serve-transport", "process",
+            "--serve-replicas", "2", "--serve-shape", "flash",
+            "--serve-rate", "6", "--serve-flash-mult", "6",
+            "--serve-requests", "220", "--serve-buckets", "1,4",
+            "--serve-mode", "continuous", "--queue-limit", "512",
+            "--serve-scale-target", "p99=2000",
+            "--serve-max-replicas", "2",
+        ),
+        "expect": {
+            "final_rc": 0, "kills__min": 1, "restarts__min": 1,
+            "failed_requests": 0, "p99_recovered": True,
+        },
+        "require_kinds": ("serve", "serve_route", "replica"),
+    },
 }
 
 

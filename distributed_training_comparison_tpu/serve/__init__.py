@@ -54,6 +54,7 @@ from .loadgen import (
     closed_loop,
     diurnal_ramp,
     flash_crowd,
+    fold_seed,
     mixed_tenants,
     open_loop,
     open_loop_profile,
@@ -88,6 +89,7 @@ __all__ = [
     "flash_crowd",
     "mixed_tenants",
     "request_pool",
+    "fold_seed",
     "build_engine",
     "serve_main",
 ]
@@ -353,6 +355,31 @@ def serve_main(hparams) -> dict:
         alert_engine = obs.AlertEngine(obs.parse_alert_specs(specs), bus=bus)
         bus.subscribe(alert_engine.observe_event)
     metrics = ServeMetrics(bus=bus, registry=registry, classes=classes)
+    # --- transport: thread (N engines here) or process (serve/fleet/ —
+    # each replica a supervised OS process behind the socket transport)
+    transport = str(getattr(hparams, "serve_transport", "thread"))
+    process_spec = None
+    if transport == "process":
+        import os
+
+        from .fleet.replica import worker_hparams_dict
+
+        wk = worker_hparams_dict(hparams)
+        wk["serve_buckets"] = list(buckets)
+        process_spec = {
+            "fleet_dir": str(Path(hparams.ckpt_path) / "serve-fleet"),
+            "events_dir": str(hparams.ckpt_path) if bus is not None else "",
+            "hparams": wk,
+            "port_base": int(getattr(hparams, "serve_port_base", 0) or 0),
+            "metrics_port_base": int(
+                getattr(hparams, "metrics_port", 0) or 0
+            ),
+            "platform": os.environ.get("JAX_PLATFORMS") or None,
+            "run_id": getattr(bus, "run_id", None),
+            "attempt": getattr(bus, "attempt", 0),
+            "aot_dir": str(aot_cache.dir) if aot_cache is not None else "",
+            "warm_buckets": list(warm) if warm else None,
+        }
     router = ServeRouter(
         engine_factory,
         replicas=n_replicas,
@@ -366,7 +393,28 @@ def serve_main(hparams) -> dict:
         warm_buckets=warm,
         plan=plan,
         monitor=monitor,
+        transport=transport,
+        process_spec=process_spec,
+        start=False,
     )
+    # --- queueing-aware autoscaling (--serve-scale-target): fit a G/G/m
+    # tail to the measured arrival/service sketches, re-size against the
+    # p99 targets live (the router ticker steps it), every decision a
+    # serve_scale event
+    autoscaler = None
+    scale_spec = getattr(hparams, "serve_scale_target", "") or ""
+    if scale_spec:
+        from .fleet.autoscale import Autoscaler, parse_scale_targets
+
+        autoscaler = Autoscaler(
+            metrics,
+            parse_scale_targets(scale_spec),
+            min_replicas=1,
+            max_replicas=int(getattr(hparams, "serve_max_replicas", 8)),
+            bus=bus,
+        )
+        router.attach_autoscaler(autoscaler)
+    router.start()
     # closed-loop autopilot for the serving path (ops/policy.py): the one
     # action that lives HERE is rewarm_serve — a post-warmup recompile
     # storm (the sentinel alert above) re-runs warmup() on the affected
@@ -382,7 +430,7 @@ def serve_main(hparams) -> dict:
     if policy_engine is not None:
         from ..ops.policy import serve_actions
 
-        policy_engine.bind_actions(serve_actions(router))
+        policy_engine.bind_actions(serve_actions(router, autoscaler))
         bus.subscribe(policy_engine.observe_event)
     exporter = obs.start_exporter(
         getattr(hparams, "metrics_port", 0),
@@ -394,33 +442,51 @@ def serve_main(hparams) -> dict:
     deadline = getattr(hparams, "deadline_ms", 0.0) or None
     try:
         router.warmup()
-        # replica 0's factory may have failed while another replica
-        # warmed fine (warmup() only needs ONE ready) — introspect any
-        # replica that actually built an engine
-        eng = first_engine[0] if first_engine else next(
-            r.engine for r in router.replicas if r.engine is not None
-        )
-        ck = eng.checkpoint_meta
-        logger.info(
-            f"[serve] model {hparams.model}, mesh {dict(eng.mesh.shape)}, "
-            f"{n_replicas} replica(s), buckets {list(eng.buckets)} "
-            f"(warmed {list(warm) if warm else 'all'}), "
-            + (
-                f"checkpoint epoch {ck['epoch']} (acc {ck['acc']:.4f})"
-                if ck
-                else "fresh weights (no checkpoint)"
+        if transport == "process":
+            # the engines live in the worker processes; introspect from
+            # the flags + the workers' health-reported stats instead
+            image_size = int(getattr(hparams, "image_size", 32) or 32)
+            stats = router.stats().get("engine", {})
+            logger.info(
+                f"[serve] model {hparams.model}, {n_replicas} process "
+                f"replica(s), buckets {list(buckets)} "
+                f"(warmed {list(warm) if warm else 'all'}), "
+                f"{stats.get('persisted_hits', 0)} programs loaded from "
+                "the persisted AOT cache"
             )
-        )
-        stats = router.stats().get("engine", {})
-        logger.info(
-            f"[serve] warm: {stats.get('compiles', 0)} bucket programs "
-            f"compiled, {stats.get('persisted_hits', 0)} loaded from the "
-            "persisted AOT cache"
-        )
+        else:
+            # replica 0's factory may have failed while another replica
+            # warmed fine (warmup() only needs ONE ready) — introspect
+            # any replica that actually built an engine
+            eng = first_engine[0] if first_engine else next(
+                r.engine for r in router.replicas if r.engine is not None
+            )
+            image_size = eng.image_size
+            ck = eng.checkpoint_meta
+            logger.info(
+                f"[serve] model {hparams.model}, mesh "
+                f"{dict(eng.mesh.shape)}, "
+                f"{n_replicas} replica(s), buckets {list(eng.buckets)} "
+                f"(warmed {list(warm) if warm else 'all'}), "
+                + (
+                    f"checkpoint epoch {ck['epoch']} (acc {ck['acc']:.4f})"
+                    if ck
+                    else "fresh weights (no checkpoint)"
+                )
+            )
+            stats = router.stats().get("engine", {})
+            logger.info(
+                f"[serve] warm: {stats.get('compiles', 0)} bucket "
+                f"programs compiled, {stats.get('persisted_hits', 0)} "
+                "loaded from the persisted AOT cache"
+            )
+        # per-attempt seed fold: a restarted serve session (or a sibling
+        # process) must not replay byte-identical request pools
         images = request_pool(
             max(256, max(buckets)),
-            image_size=eng.image_size,
+            image_size=image_size,
             seed=hparams.seed,
+            fold=("serve", getattr(bus, "attempt", 0) if bus else 0),
         )
         report = _run_load_shape(hparams, router, images, deadline)
     finally:

@@ -318,6 +318,10 @@ class ClassQueue:
             )
             self._n += 1
             self._cond.notify()
+        # admitted: stamp the arrival sketch (the autoscaler's λ / ca²
+        # input).  Sheds are deliberately not arrivals-for-sizing — they
+        # never became offered load a replica could serve.
+        self.metrics.record_arrival(slo.name)
         if victim is not None:
             # resolved OUTSIDE the lock: the victim's waiter may react
             _, vfut = victim
@@ -463,6 +467,47 @@ class ClassQueue:
             self.metrics.record_batch(len(batch), depth_after)
         return batch
 
+    def requeue(self, entries) -> int:
+        """Return undispatched ``(image, future)`` entries to the FRONT
+        of their priority lanes (age preserved — they were admitted
+        first and must dispatch first).
+
+        The process-replica crash path: a worker that dies mid-dispatch
+        never resolved these futures and prediction is pure, so the
+        batch goes back for the next incarnation (or another replica)
+        instead of failing — a replica crash costs latency, not
+        requests.  Entries whose future already resolved (deadline fired
+        meanwhile) are skipped; on a closed queue they fail typed.
+        Returns the number actually requeued.
+        """
+        failed_cls = []
+        n = 0
+        with self._cond:
+            for image, fut in reversed(list(entries)):
+                if fut.done():
+                    continue
+                if self._closed:
+                    if fut.set_error(
+                        BatcherClosed("replica lost mid-dispatch during "
+                                      "shutdown")
+                    ):
+                        failed_cls.append(fut.cls)
+                    continue
+                try:
+                    priority = self.classes[fut.cls].priority
+                except KeyError:
+                    priority = 1
+                self._lanes.setdefault(priority, deque()).appendleft(
+                    (image, fut)
+                )
+                self._n += 1
+                n += 1
+            if n:
+                self._cond.notify_all()
+        for cls in failed_cls:
+            self.metrics.record_failed(cls)
+        return n
+
     # -------------------------------------------------------------- close
 
     def close(self, drain: bool = True) -> None:
@@ -588,11 +633,14 @@ class MicroBatcher:
         self.close()
 
 
-def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> None:
+def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> list:
     """Run one coalesced batch through ``engine`` and resolve its
     futures — the shared worker body of :class:`MicroBatcher` and every
     router replica.  Engine failure fails the batch (typed, counted) and
-    the caller keeps serving."""
+    the caller keeps serving.  Returns the futures that completed OK
+    (the per-replica class-latency input; losers of a ``mark_dead`` race
+    are excluded)."""
+    t0 = time.monotonic()
     try:
         logits = engine.predict_logits(
             np.stack([img for img, _ in batch])
@@ -602,7 +650,9 @@ def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> None:
         for _, fut in batch:
             if fut.set_error(e):
                 metrics.record_failed(fut.cls)
-        return
+        return []
+    metrics.record_service(time.monotonic() - t0, len(batch))
+    completed = []
     for (_, fut), row in zip(batch, logits):
         if not fut.set_result(row):
             # already failed by mark_dead while this dispatch ran: the
@@ -614,3 +664,5 @@ def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> None:
             fut.latency_s, cls=fut.cls,
             within_deadline=fut.within_deadline,
         )
+        completed.append(fut)
+    return completed

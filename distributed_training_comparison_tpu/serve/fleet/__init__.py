@@ -1,0 +1,56 @@
+"""Process-per-replica serving: transport, worker lifecycle, autoscaling.
+
+The thread fleet (PR 14) stays the fast in-test default; this package is
+the ``--serve-transport process`` promotion — real OS processes with
+their own jax runtimes and device sets behind a length-prefixed socket
+protocol, supervised with the training restart machinery, sized by a
+queueing model against p99 targets instead of utilization.
+"""
+
+from .autoscale import (
+    SCALE_KIND,
+    Autoscaler,
+    parse_scale_targets,
+    predicted_p99_s,
+    size_for_targets,
+    wq_ggm,
+)
+from .replica import (
+    ProcessReplica,
+    read_handshake,
+    worker_hparams_dict,
+    write_worker_spec,
+)
+from .transport import (
+    FleetTransportError,
+    ReplicaClient,
+    decode_array,
+    encode_array,
+    recv_msg,
+    render_worker_env,
+    replica_metrics_port,
+    replica_port,
+    send_msg,
+)
+
+__all__ = [
+    "SCALE_KIND",
+    "Autoscaler",
+    "FleetTransportError",
+    "ProcessReplica",
+    "ReplicaClient",
+    "decode_array",
+    "encode_array",
+    "parse_scale_targets",
+    "predicted_p99_s",
+    "read_handshake",
+    "recv_msg",
+    "render_worker_env",
+    "replica_metrics_port",
+    "replica_port",
+    "send_msg",
+    "size_for_targets",
+    "worker_hparams_dict",
+    "wq_ggm",
+    "write_worker_spec",
+]

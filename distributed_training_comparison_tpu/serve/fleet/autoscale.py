@@ -1,0 +1,339 @@
+"""Queueing-aware fleet sizing: p99 *targets*, not utilization.
+
+PR 14's ``plan_serve`` sizes replicas so offered load stays under a
+utilization ceiling — a throughput argument that says nothing about the
+tail.  This module fits a queueing model to what the serve metrics
+already measure and sizes the fleet against per-class p99 targets:
+
+- **Arrivals** from the per-class arrival sketches
+  (``ServeMetrics.arrival_stats``): rate ``λ`` and interarrival
+  squared-CV ``ca²`` over a sliding window.
+- **Service** from the per-dispatch service reservoir
+  (``ServeMetrics.service_stats``): mean, squared-CV ``cs²``, p99, and
+  mean batch size.  The model works at the *batch* level — a dispatch is
+  the unit of server work, so ``λ_batch = λ_req / E[batch]``.
+- **Wait** from the Allen–Cunneen / Sakasegawa G/G/m approximation
+  (exact M/G/1 Pollaczek–Khinchine when ``m=1, ca²=1``)::
+
+      ρ  = λ·E[S] / m
+      Wq ≈ (ca² + cs²)/2 · ρ^√(2(m+1))/(1−ρ) · E[S]/m
+
+  with an exponential wait-tail (``p99_wait ≈ −ln(.01)·Wq``), so
+  ``predicted_p99 ≈ p99_service + 4.605·Wq``.
+
+The sizer picks the smallest ``m`` whose predicted p99 meets every
+targeted class (FCFS approximation: priority lanes tighten gold's real
+tail below the prediction, so the bound is conservative for high
+priority and honest for the rest).  Degrades are explicit: too few
+samples for a tail fit → the PR-14 utilization rule on the measured
+mean; no samples at all → hold.
+
+:class:`Autoscaler` wraps the math in a control loop: scale-up acts on
+the next tick, scale-down needs ``hold`` consecutive votes *and*
+headroom (predicted p99 under ``headroom × target`` at the smaller
+fleet), both behind a cooldown — flash crowds grow the fleet fast, the
+quiet after them shrinks it reluctantly.  Every decision emits a
+registered ``serve_scale`` event; the same evaluation backs the
+``scale_serve`` autopilot action.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+SCALE_KIND = "serve_scale"
+
+# −ln(0.01): exponential wait-tail quantile multiplier
+_P99_TAIL = 4.605170185988091
+
+# tail fits need a populated reservoir; below this fall back to the
+# utilization rule, below MIN_MEAN hold entirely
+MIN_TAIL_SAMPLES = 20
+MIN_MEAN_SAMPLES = 3
+
+UTILIZATION_FALLBACK = 0.7  # = router.PLAN_UTILIZATION, kept literal to
+# avoid importing the router into the math module the tests isolate
+
+
+def parse_scale_targets(spec: str) -> dict[str, float]:
+    """``--serve-scale-target`` grammar → ``{class: p99_seconds}``.
+
+    ``p99=250`` targets every class at 250 ms; ``gold:p99=150,
+    default:p99=400`` targets per class.  ``*`` is the any-class key.
+    """
+    out: dict[str, float] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, _, kv = part.rpartition(":")
+        cls = cls.strip() or "*"
+        k, eq, v = kv.partition("=")
+        if k.strip() != "p99" or not eq:
+            raise ValueError(
+                f"bad scale target {part!r}: want [CLASS:]p99=MILLIS"
+            )
+        try:
+            ms = float(v)
+        except ValueError as e:
+            raise ValueError(f"bad scale target {part!r}: {e}") from e
+        if ms <= 0:
+            raise ValueError(f"bad scale target {part!r}: p99 must be > 0")
+        out[cls] = ms / 1000.0
+    if not out:
+        raise ValueError(f"empty scale target spec {spec!r}")
+    return out
+
+
+def wq_ggm(lam: float, mean_s: float, m: int, *, ca2: float = 1.0,
+           cs2: float = 1.0) -> float:
+    """Expected queue wait (seconds) for G/G/m via Sakasegawa.
+    ``inf`` when the fleet is saturated (ρ ≥ 1)."""
+    if lam <= 0 or mean_s <= 0:
+        return 0.0
+    m = max(1, int(m))
+    rho = lam * mean_s / m
+    if rho >= 1.0:
+        return math.inf
+    vari = max(0.0, (ca2 + cs2) / 2.0)
+    return vari * (rho ** math.sqrt(2.0 * (m + 1)) / (1.0 - rho)) * (
+        mean_s / m
+    )
+
+
+def predicted_p99_s(lam: float, service: dict, m: int, *,
+                    ca2: float = 1.0) -> float:
+    """Predicted request p99 at fleet size ``m``: batch-level queue wait
+    tail plus the measured service tail."""
+    mean_batch = max(1.0, float(service.get("mean_batch") or 1.0))
+    lam_batch = lam / mean_batch
+    wq = wq_ggm(
+        lam_batch, float(service.get("mean_s") or 0.0), m,
+        ca2=ca2, cs2=float(service.get("cv2") or 1.0),
+    )
+    if math.isinf(wq):
+        return math.inf
+    return float(service.get("p99_s") or 0.0) + _P99_TAIL * wq
+
+
+def size_for_targets(
+    lam: float, service: dict, targets: dict[str, float], *,
+    min_replicas: int = 1, max_replicas: int = 8, ca2: float = 1.0,
+    classes=None,
+) -> tuple[int, str, list[dict]]:
+    """The pure sizing decision: ``(m, sized_by, per-class rows)``.
+
+    ``sized_by`` records which rule produced ``m``: ``"ggm"`` (tail
+    fit), ``"utilization"`` (too few samples for a tail — PR-14 rule on
+    the measured mean), or ``"no-data"`` (hold at ``min_replicas``).
+    """
+    n = int(service.get("n") or 0)
+    names = sorted(
+        set(classes or ()) | {c for c in targets if c != "*"}
+    ) or ["*"]
+    rows: list[dict] = []
+    if n < MIN_MEAN_SAMPLES or lam <= 0:
+        return max(1, int(min_replicas)), "no-data", rows
+
+    mean_s = float(service.get("mean_s") or 0.0)
+    mean_batch = max(1.0, float(service.get("mean_batch") or 1.0))
+    if n < MIN_TAIL_SAMPLES:
+        # not enough dispatches for cv²/p99 to mean anything: the PR-14
+        # rule — size so offered batches stay under the utilization
+        # ceiling of the measured mean service rate
+        lam_batch = lam / mean_batch
+        need = 1 if mean_s <= 0 else math.ceil(
+            lam_batch * mean_s / UTILIZATION_FALLBACK
+        )
+        m = min(max(int(min_replicas), int(need)), int(max_replicas))
+        return max(1, m), "utilization", rows
+
+    m = max(1, int(min_replicas))
+    for cand in range(m, int(max_replicas) + 1):
+        ok = True
+        rows = []
+        for cls in names:
+            tgt = targets.get(cls, targets.get("*"))
+            pred = predicted_p99_s(lam, service, cand, ca2=ca2)
+            rows.append({
+                "cls": cls,
+                "target_p99_ms": None if tgt is None else tgt * 1000.0,
+                "predicted_p99_ms": (
+                    None if math.isinf(pred) else pred * 1000.0
+                ),
+                "m": cand,
+            })
+            if tgt is not None and pred > tgt:
+                ok = False
+        if ok:
+            return cand, "ggm", rows
+        m = cand
+    return int(max_replicas), "ggm", rows
+
+
+class Autoscaler:
+    """The live loop: measure → size → (maybe) resize, with hysteresis.
+
+    Pulls arrivals and service from a ``ServeMetrics`` (anything with
+    ``arrival_stats(window_s)`` and ``service_stats()`` works — the
+    tests pass a stub), emits ``serve_scale`` events, and applies
+    resizes through the router's ``scale_to``.
+    """
+
+    def __init__(
+        self, metrics, targets: dict[str, float], *,
+        min_replicas: int = 1, max_replicas: int = 8,
+        window_s: float = 30.0, cooldown_s: float = 15.0,
+        hold: int = 2, headroom: float = 0.8,
+        bus=None, clock=time.monotonic,
+    ) -> None:
+        self.metrics = metrics
+        self.targets = dict(targets)
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.hold = max(1, int(hold))
+        self.headroom = float(headroom)
+        self.bus = bus
+        self._clock = clock
+        self._last_applied_t: float | None = None
+        self._down_streak = 0
+        self.decisions = 0
+        self.applied = 0
+        self.last_decision: dict | None = None
+
+    # ------------------------------------------------------------ math
+
+    def evaluate(self, current: int) -> dict:
+        """One sizing evaluation (no side effects beyond counters)."""
+        arr = self.metrics.arrival_stats(self.window_s)
+        svc = self.metrics.service_stats()
+        lam = float(arr.get("lam_rps") or 0.0)
+        ca2 = float(arr.get("ca2") or 1.0)
+        proposed, sized_by, rows = size_for_targets(
+            lam, svc, self.targets,
+            min_replicas=self.min_replicas,
+            max_replicas=self.max_replicas,
+            ca2=ca2, classes=self._class_names(),
+        )
+        if sized_by == "no-data":
+            proposed = current  # nothing measured: hold, don't thrash
+        return {
+            "current": int(current),
+            "proposed": int(proposed),
+            "sized_by": sized_by,
+            "lam_rps": round(lam, 3),
+            "ca2": round(ca2, 3),
+            "service": {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in svc.items()
+            },
+            "rows": rows,
+            "targets_ms": {
+                c: t * 1000.0 for c, t in self.targets.items()
+            },
+        }
+
+    def _class_names(self):
+        classes = getattr(self.metrics, "classes", None)
+        if classes:
+            try:
+                return list(classes.keys())
+            except AttributeError:
+                return list(classes)
+        return None
+
+    # ------------------------------------------------------------ loop
+
+    def _cooldown_left(self, now: float) -> float:
+        if self._last_applied_t is None:
+            return 0.0
+        return max(0.0, self.cooldown_s - (now - self._last_applied_t))
+
+    def _emit(self, state: str, decision: dict, **extra) -> None:
+        if self.bus is None:
+            return
+        # the decision dict carries its own "state" by the time some
+        # emits fire — the explicit arg wins, never a duplicate kwarg
+        payload = {k: v for k, v in decision.items() if k != "state"}
+        self.bus.emit(SCALE_KIND, state=state, **payload, **extra)
+
+    def step(self, router, *, force: bool = False) -> dict:
+        """One control-loop tick: evaluate and maybe resize ``router``.
+
+        Scale-up applies immediately (cooldown permitting); scale-down
+        needs ``hold`` consecutive down-votes and the proposal to clear
+        the headroom'd target.  ``force`` (the ``scale_serve`` autopilot
+        action) skips cooldown and hysteresis but never the math.
+        """
+        now = self._clock()
+        current = router.active_replicas()
+        decision = self.evaluate(current)
+        decision["forced"] = bool(force)
+        self.decisions += 1
+        self.last_decision = decision
+        proposed = decision["proposed"]
+
+        if proposed == current:
+            self._down_streak = 0
+            decision["state"] = "steady"
+            return decision
+
+        cooldown = self._cooldown_left(now)
+        if cooldown > 0 and not force:
+            decision["state"] = "hold"
+            decision["reason"] = f"cooldown {cooldown:.1f}s"
+            self._emit("hold", decision)
+            return decision
+
+        if proposed < current and not force:
+            self._down_streak += 1
+            decision["streak"] = self._down_streak
+            # headroom: only shrink when the smaller fleet clears the
+            # *tightened* target, not just barely meets it
+            svc = decision["service"]
+            tight = min(
+                (t for t in self.targets.values()), default=None
+            )
+            pred = predicted_p99_s(
+                decision["lam_rps"], svc, proposed,
+                ca2=decision["ca2"],
+            )
+            clears = (
+                tight is None or decision["sized_by"] != "ggm"
+                or pred <= self.headroom * tight
+            )
+            if self._down_streak < self.hold or not clears:
+                decision["state"] = "hold"
+                decision["reason"] = (
+                    f"scale-down hysteresis (streak "
+                    f"{self._down_streak}/{self.hold}, "
+                    f"headroom_ok={clears})"
+                )
+                self._emit("hold", decision)
+                return decision
+
+        self._down_streak = 0
+        decision["state"] = "decision"
+        self._emit("decision", decision)
+        result = router.scale_to(proposed)
+        self._last_applied_t = self._clock()
+        self.applied += 1
+        decision["state"] = "applied"
+        decision.update(result or {})
+        self._emit("applied", decision)
+        return decision
+
+    def describe(self) -> dict:
+        return {
+            "targets_ms": {
+                c: t * 1000.0 for c, t in self.targets.items()
+            },
+            "decisions": self.decisions,
+            "applied": self.applied,
+            "down_streak": self._down_streak,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+        }

@@ -1,0 +1,663 @@
+"""Process replicas: the worker entry point and its router-side handle.
+
+Two halves, one contract:
+
+- :func:`worker_main` — the replica **process**: build one
+  ``ServeEngine`` from the spec file, warm it, then serve the transport
+  ops (submit / health / drain / stats / shutdown) on its deterministic
+  port.  The worker owns its own jax runtime and device set (rendered
+  into its environment by the spawner), its own event file
+  (``events-p{1+rid}.jsonl`` in the shared run root — the bus's
+  per-process convention), its own OpenMetrics exporter port
+  (``metrics_base + 1 + rid``), and a bounded-cadence
+  ``obs.heartbeat.HeartbeatEmitter`` so the liveness machinery that
+  watches training hosts reads replica processes unchanged.
+- :class:`ProcessReplica` — the **router-side** handle implementing the
+  same interface as the in-thread ``router.Replica``: one dispatcher
+  thread pulls coalesced batches from the shared SLO-class queue and
+  round-trips them over the socket; one supervisor thread reuses
+  ``resilience.supervisor.Supervisor`` — the *training* restart loop —
+  for replica lifecycle: spawn, wait on the pid, exponential backoff,
+  restart budget, orderly stop.  A worker that dies mid-dispatch gets
+  its in-flight batch **requeued** (prediction is pure; the futures were
+  never resolved), so a replica crash costs latency, not requests —
+  recovery beyond the budget fails typed, exactly like the thread
+  fleet's ``mark_dead``.
+
+The queue, classes, deadlines, shedding, and futures all stay in the
+router process; a replica worker never sees an SLO class.  That is the
+point of the transport: the concurrency substrate changed, the serving
+semantics did not.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from ...resilience.supervisor import PlanRefused, Supervisor
+from ..batcher import ReplicaDead
+from ..router import (
+    DEAD,
+    DRAINING,
+    READY,
+    STARTING,
+    STOPPED,
+    Replica,
+)
+from .transport import (
+    HOST,
+    FleetTransportError,
+    ReplicaClient,
+    decode_array,
+    encode_array,
+    recv_msg,
+    render_worker_env,
+    replica_metrics_port,
+    replica_port,
+    send_msg,
+)
+
+WORKER_MODULE = "distributed_training_comparison_tpu.serve.fleet.replica"
+
+# replica-process restart policy: serving workers are cheap to relaunch
+# (warm-start from the persisted AOT cache), so back off fast and give up
+# after a few crashes — a worker that cannot hold a socket open twice
+# in a row is broken, not preempted
+RESTARTS_DEFAULT = 2
+BACKOFF_BASE_S = 0.25
+BACKOFF_MAX_S = 4.0
+
+# the attrs ``serve.build_engine`` / checkpoint discovery actually read —
+# the worker spec carries exactly these, not the whole flag namespace
+_HPARAM_KEYS = (
+    "model", "precision", "amp", "stem", "image_size", "patch_size",
+    "moe_dispatch", "block_fusion", "parallel_style", "model_parallel",
+    "num_devices", "serve_ckpt", "ckpt_path", "serve_buckets", "seed",
+)
+
+
+def worker_hparams_dict(hparams) -> dict:
+    """The JSON-safe slice of a flag namespace a worker process needs to
+    rebuild the engine (``build_engine`` reads nothing else)."""
+    out = {}
+    for k in _HPARAM_KEYS:
+        v = getattr(hparams, k, None)
+        if isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+def spec_path(fleet_dir: str | Path, rid: int) -> Path:
+    return Path(fleet_dir) / f"replica-{int(rid)}.spec.json"
+
+
+def handshake_path(fleet_dir: str | Path, rid: int) -> Path:
+    return Path(fleet_dir) / f"replica-{int(rid)}.json"
+
+
+def write_worker_spec(fleet_dir: str | Path, rid: int, spec: dict) -> Path:
+    """Persist one replica's spec (atomic rename — a half-written spec
+    must never launch a worker)."""
+    fleet_dir = Path(fleet_dir)
+    fleet_dir.mkdir(parents=True, exist_ok=True)
+    path = spec_path(fleet_dir, rid)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(spec, indent=1))
+    os.replace(tmp, path)
+    return path
+
+
+def _write_handshake(path: Path, payload: dict) -> None:
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps(payload))
+    os.replace(tmp, path)
+
+
+def read_handshake(fleet_dir: str | Path, rid: int) -> dict | None:
+    try:
+        return json.loads(handshake_path(fleet_dir, rid).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+# ------------------------------------------------------------ the worker
+
+
+def worker_main(path: str) -> int:
+    """One replica process: engine + warmup + the transport serve loop.
+
+    Exit code 0 = deliberate (drain/shutdown ack'd) — the supervisor does
+    not relaunch it.  Anything else is a crash the supervisor retries
+    inside its budget.
+    """
+    spec = json.loads(Path(path).read_text())
+    rid = int(spec["rid"])
+    fleet_dir = Path(spec["fleet_dir"])
+    hs = handshake_path(fleet_dir, rid)
+    _write_handshake(hs, {"pid": os.getpid(), "state": "warming"})
+
+    from ... import obs
+    from ...utils import PersistedServeCache
+    from .. import build_engine
+
+    # the worker joins the run's event stream as process 1+rid (the
+    # router process keeps index 0): its compile/heartbeat/replica
+    # events land in events-p{1+rid}.jsonl next to the router's
+    bus = obs.configure(
+        run_id=spec.get("run_id"),
+        attempt=int(spec.get("attempt", 0) or 0),
+        process_index=1 + rid,
+    )
+    if spec.get("events_dir"):
+        bus.bind_dir(spec["events_dir"])
+    registry = obs.MetricRegistry()
+    monitor = obs.CompileMonitor(bus=bus, registry=registry)
+    aot_cache = (
+        PersistedServeCache(spec["aot_dir"]) if spec.get("aot_dir") else None
+    )
+    from types import SimpleNamespace
+
+    engine = build_engine(
+        SimpleNamespace(**spec["hparams"]),
+        monitor=monitor,
+        aot_cache=aot_cache,
+        arm_sentinel=False,
+    )
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((HOST, int(spec.get("port", 0) or 0)))
+    srv.listen(8)
+    srv.settimeout(0.25)
+    port = srv.getsockname()[1]
+
+    warm = spec.get("warm_buckets") or None
+    engine.warmup(warm)
+    # warmed and listening: the sentinel arms here (per process — each
+    # worker owns its monitor), and the handshake flips to ready so the
+    # router's dispatcher connects
+    monitor.warm()
+    exporter = obs.start_exporter(
+        int(spec.get("metrics_port_base", 0) or 0),
+        process_index=1 + rid,
+        registry=registry,
+    )
+    beats = obs.heartbeat.HeartbeatEmitter(
+        bus, every_s=float(spec.get("heartbeat_every_s", 5.0))
+    )
+    bus.emit(
+        "replica", replica=rid, state=READY, transport="process",
+        pid=os.getpid(), port=port,
+        buckets=list(engine.buckets),
+        warmed=list(warm or engine.buckets),
+        persisted_hits=engine.stats().get("persisted_hits", 0),
+    )
+    _write_handshake(
+        hs, {"pid": os.getpid(), "port": port, "state": "ready"}
+    )
+
+    stop = threading.Event()
+    rc_box = {"rc": 0}
+    engine_lock = threading.Lock()
+    counters = {"dispatches": 0, "served": 0}
+
+    def handle(conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while not stop.is_set():
+                try:
+                    header, body = recv_msg(conn)
+                except FleetTransportError:
+                    return  # peer went away: this connection is done
+                op = header.get("op")
+                if op == "submit":
+                    images = decode_array(header, body)
+                    try:
+                        with engine_lock:
+                            logits = np.asarray(
+                                engine.predict_logits(images)
+                            )
+                            counters["dispatches"] += 1
+                            counters["served"] += int(images.shape[0])
+                    except Exception as e:  # engine error: typed, not fatal
+                        send_msg(conn, {
+                            "op": "error",
+                            "etype": type(e).__name__,
+                            "error": str(e)[:300],
+                        })
+                        continue
+                    meta, rbody = encode_array(logits)
+                    send_msg(conn, {"op": "result", **meta}, rbody)
+                    beats.beat(
+                        replica=rid, pid=os.getpid(),
+                        dispatches=counters["dispatches"],
+                    )
+                elif op == "health":
+                    send_msg(conn, {
+                        "op": "health", "state": READY, "pid": os.getpid(),
+                        "port": port, **counters,
+                        "stats": engine.stats(),
+                    })
+                elif op == "stats":
+                    send_msg(conn, {"op": "stats", "stats": engine.stats()})
+                elif op == "drain":
+                    # finish the in-flight dispatch (the engine lock IS
+                    # the in-flight marker), then ack and exit clean
+                    with engine_lock:
+                        send_msg(conn, {
+                            "op": "drained", **counters,
+                            "stats": engine.stats(),
+                        })
+                    stop.set()
+                    return
+                elif op == "shutdown":
+                    send_msg(conn, {"op": "bye"})
+                    stop.set()
+                    return
+                else:
+                    send_msg(conn, {
+                        "op": "error", "etype": "ValueError",
+                        "error": f"unknown op {op!r}",
+                    })
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    try:
+        while not stop.is_set():
+            try:
+                conn, _addr = srv.accept()
+            except socket.timeout:
+                continue
+            threading.Thread(
+                target=handle, args=(conn,), daemon=True,
+                name=f"serve-worker-{rid}-conn",
+            ).start()
+    finally:
+        srv.close()
+        bus.emit(
+            "replica", replica=rid, state=STOPPED, transport="process",
+            pid=os.getpid(), **counters,
+        )
+        if exporter is not None:
+            exporter.close()
+        bus.close()
+    return rc_box["rc"]
+
+
+# -------------------------------------------------- router-side replica
+
+
+class _ReplicaSupervisor(Supervisor):
+    """The training restart loop, pointed at one replica worker: same
+    backoff arithmetic, same budget, same attempt events — but an
+    orderly stop must also cancel a *pending* relaunch (the base class
+    only checks between launch and backoff)."""
+
+    def _plan_attempt(self, attempt: int) -> None:
+        if attempt and self._stop_reason:
+            raise PlanRefused(self._stop_reason)
+
+
+class ProcessReplica(Replica):
+    """One replica as a real OS process behind the socket transport.
+
+    Same interface and state machine as the in-thread ``Replica`` (the
+    router cannot tell them apart): ``state`` / ``drain()`` /
+    ``mark_dead()`` / ``describe()`` / the shared-queue pull loop.  The
+    differences are the substrate: the engine lives in a child process
+    the supervisor thread relaunches inside a restart budget, and a
+    dispatch that loses its worker requeues instead of failing.
+    """
+
+    transport = "process"
+
+    def __init__(
+        self,
+        rid: int,
+        spec: dict,
+        queue,
+        metrics,
+        *,
+        mode: str = "continuous",
+        max_wait_s: float = 0.002,
+        warm_buckets=None,
+        bus=None,
+        beat_every_s: float | None = None,
+        max_restarts: int = RESTARTS_DEFAULT,
+        backoff_base: float = BACKOFF_BASE_S,
+        backoff_max: float = BACKOFF_MAX_S,
+    ) -> None:
+        kw = {} if beat_every_s is None else {"beat_every_s": beat_every_s}
+        super().__init__(
+            rid, None, queue, metrics, mode=mode, max_wait_s=max_wait_s,
+            warm_buckets=warm_buckets, bus=bus, **kw,
+        )
+        self.spec = dict(spec)
+        self.spec["rid"] = int(rid)
+        self.spec.setdefault(
+            "port", replica_port(self.spec.get("port_base", 0), rid)
+        )
+        if warm_buckets is not None:
+            self.spec.setdefault("warm_buckets", list(warm_buckets))
+        self.fleet_dir = Path(self.spec["fleet_dir"])
+        self.max_bucket = max(
+            int(b) for b in self.spec["hparams"]["serve_buckets"]
+        )
+        self.pid: int | None = None
+        self.port: int | None = None
+        self.restarts = 0
+        self._client: ReplicaClient | None = None
+        self._engine_stats: dict | None = None
+        self._proc: subprocess.Popen | None = None
+        self._stop_event = threading.Event()
+        self._sup: _ReplicaSupervisor | None = None
+        # the dispatcher replaces the thread transport's in-process
+        # worker; the supervisor thread is new — threads share the base
+        # class's lock/state
+        self._thread = threading.Thread(
+            target=self._dispatch_loop,
+            name=f"serve-replica-p{self.rid}", daemon=True,
+        )
+        self._sup_thread = threading.Thread(
+            target=self._supervise,
+            name=f"serve-replica-p{self.rid}-sup", daemon=True,
+        )
+        self._max_restarts = int(max_restarts)
+        self._backoff_base = float(backoff_base)
+        self._backoff_max = float(backoff_max)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "ProcessReplica":
+        if self._sup_thread.ident is None:
+            self._sup_thread.start()
+        if self._thread.ident is None:
+            self._thread.start()
+        return self
+
+    def _render_cmd(self) -> list[str]:
+        path = write_worker_spec(self.fleet_dir, self.rid, self.spec)
+        return [
+            self.spec.get("python") or sys.executable,
+            "-m", WORKER_MODULE, str(path),
+        ]
+
+    def _render_env(self) -> dict:
+        env = render_worker_env(
+            os.environ, self.rid,
+            platform=self.spec.get("platform"),
+            visible_devices=self.spec.get("visible_devices"),
+        )
+        # a worker must not inherit the router's distributed coordination
+        # or re-trigger its profile hooks
+        env.pop("DTC_RUN_ID", None)
+        env.pop("DTC_ATTEMPT", None)
+        return env
+
+    def _run_attempt(self, cmd, env) -> int:
+        hs = handshake_path(self.fleet_dir, self.rid)
+        try:
+            os.remove(hs)  # a stale port must not look ready
+        except OSError:
+            pass
+        log_path = self.fleet_dir / f"replica-{self.rid}.log"
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+            self._proc = proc
+            self.pid = proc.pid
+            return proc.wait()
+
+    def _interruptible_sleep(self, seconds: float) -> None:
+        self._stop_event.wait(seconds)
+
+    def _sup_event(self, kind: str, **payload) -> None:
+        if kind == "attempt_start" and payload.get("attempt", 0):
+            self.restarts = int(payload["attempt"])
+        if self.bus is not None:
+            self.bus.emit(
+                "replica", replica=self.rid, state=self.state,
+                transport=self.transport, lifecycle=kind,
+                pid=self.pid, **payload,
+            )
+
+    def _supervise(self) -> None:
+        self._sup = _ReplicaSupervisor(
+            cmd=lambda attempt: self._render_cmd(),
+            env=lambda attempt: self._render_env(),
+            max_restarts=self._max_restarts,
+            backoff_base=self._backoff_base,
+            backoff_max=self._backoff_max,
+            runner=self._run_attempt,
+            sleep=self._interruptible_sleep,
+            log=lambda msg: None,
+            events=self._sup_event,
+        )
+        summary = self._sup.run()
+        self.restarts = max(self.restarts, int(summary.get("restarts", 0)))
+        rc = summary.get("final_rc", 0)
+        with self._lock:
+            terminal = self.state in (STOPPED, DEAD)
+        if terminal:
+            return
+        if rc == 0:
+            # deliberate drain/shutdown ack'd by the worker
+            self._transition(
+                STOPPED, dispatches=self.dispatches, routed=self.routed,
+                restarts=self.restarts,
+            )
+        else:
+            # crashed through the whole budget: the fleet's health
+            # verdict, same as a stale-heartbeat death
+            self.error = f"worker exited rc={rc} (budget exhausted)"
+            self.mark_dead(self.error)
+
+    # ------------------------------------------------------ dispatcher
+
+    def _ensure_client(self) -> ReplicaClient | None:
+        if self._client is not None:
+            return self._client
+        hs = read_handshake(self.fleet_dir, self.rid)
+        if not hs or hs.get("state") != "ready" or not hs.get("port"):
+            return None
+        try:
+            client = ReplicaClient(hs["port"], connect_timeout_s=2.0)
+            health = client.health()
+        except FleetTransportError:
+            return None
+        self.pid = int(hs.get("pid") or 0) or self.pid
+        self.port = int(hs["port"])
+        self._engine_stats = health.get("stats") or self._engine_stats
+        self._client = client
+        with self._lock:
+            was = self.state
+        if was == STARTING:
+            self._transition(
+                READY, pid=self.pid, port=self.port,
+                transport=self.transport, restart=self.restarts,
+                persisted_hits=(health.get("stats") or {}).get(
+                    "persisted_hits", 0
+                ),
+            )
+        self.last_beat = time.monotonic()
+        return client
+
+    def _drop_client(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    st = self.state
+                if st in (STOPPED, DEAD):
+                    return  # supervisor thread owns the terminal event
+                client = self._ensure_client()
+                if client is None:
+                    time.sleep(0.05)
+                    continue
+                if st == DRAINING:
+                    break
+                self._beat()
+                batch = self.queue.take(
+                    self.max_bucket,
+                    window_s=self.max_wait_s,
+                    continuous=self.mode == "continuous",
+                    timeout_s=0.25,
+                )
+                if batch is None:
+                    break  # queue closed and drained
+                if not batch:
+                    continue
+                with self._lock:
+                    if self.state == DEAD:
+                        doomed, batch = batch, []
+                    else:
+                        doomed = []
+                        self._inflight = batch
+                for _, fut in doomed:
+                    if fut.set_error(
+                        ReplicaDead(
+                            f"replica {self.rid} died with this request "
+                            "admitted but not dispatched"
+                        )
+                    ):
+                        self.metrics.record_failed(fut.cls)
+                if not batch:
+                    return
+                self._beat()
+                t0 = time.monotonic()
+                try:
+                    logits = client.submit_batch(
+                        np.stack([img for img, _ in batch])
+                    )
+                except FleetTransportError as e:
+                    # the worker vanished mid-dispatch.  Prediction is
+                    # pure and these futures never resolved: requeue at
+                    # the FRONT of their lanes (age preserved) and let
+                    # the supervisor's next incarnation serve them — a
+                    # replica crash costs latency, not requests.
+                    with self._lock:
+                        inflight, self._inflight = self._inflight, []
+                    requeued = self.queue.requeue(inflight)
+                    self._drop_client()
+                    with self._lock:
+                        lost_while_ready = self.state == READY
+                    if lost_while_ready:
+                        self._transition(
+                            STARTING, requeued=requeued,
+                            reason=f"worker connection lost: {e}"[:200],
+                        )
+                    continue
+                except Exception as e:
+                    # the worker survived and relayed an engine error:
+                    # fail the batch typed, keep serving (the thread
+                    # path's dispatch_batch contract)
+                    self.metrics.record_error()
+                    with self._lock:
+                        self._inflight = []
+                    for _, fut in batch:
+                        if fut.set_error(e):
+                            self.metrics.record_failed(fut.cls)
+                    continue
+                self.metrics.record_service(
+                    time.monotonic() - t0, len(batch)
+                )
+                for (_, fut), row in zip(batch, np.asarray(logits)):
+                    if fut.set_result(row):
+                        self.metrics.record_request_done(
+                            fut.latency_s, cls=fut.cls,
+                            within_deadline=fut.within_deadline,
+                        )
+                        self._note_done(fut)
+                with self._lock:
+                    self._inflight = []
+                    self.dispatches += 1
+                    self.routed += len(batch)
+                self._beat()
+        finally:
+            self._shutdown_worker()
+
+    def _shutdown_worker(self) -> None:
+        """Orderly worker stop at dispatcher exit: drain RPC (clean exit
+        0 ends the supervisor loop), falling back to terminate."""
+        self._stop_event.set()
+        if self._sup is not None:
+            self._sup.request_stop("dispatcher closed")
+        client = self._client or self._try_connect_quick()
+        if client is not None:
+            try:
+                reply = client.drain()
+                self._engine_stats = reply.get("stats") or self._engine_stats
+            except FleetTransportError:
+                pass
+            client.close()
+            self._client = None
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    def _try_connect_quick(self) -> ReplicaClient | None:
+        hs = read_handshake(self.fleet_dir, self.rid)
+        if not hs or hs.get("state") != "ready" or not hs.get("port"):
+            return None
+        try:
+            return ReplicaClient(hs["port"], connect_timeout_s=1.0)
+        except FleetTransportError:
+            return None
+
+    # --------------------------------------------------------- control
+
+    def mark_dead(self, why: str = "stale heartbeat") -> int:
+        failed = super().mark_dead(why)
+        self._stop_event.set()
+        if self._sup is not None:
+            self._sup.request_stop(why)
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        self._drop_client()
+        return failed
+
+    def join(self, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        self._thread.join(timeout)
+        if self._sup_thread.is_alive():
+            self._sup_thread.join(
+                max(0.0, deadline - time.monotonic())
+            )
+
+    def engine_stats(self) -> dict | None:
+        return self._engine_stats
+
+    def describe(self) -> dict:
+        out = super().describe()
+        out.update(
+            pid=self.pid, port=self.port, restarts=self.restarts,
+        )
+        return out
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main(sys.argv[1]))

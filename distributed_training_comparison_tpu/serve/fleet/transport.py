@@ -1,0 +1,247 @@
+"""The process-per-replica wire protocol: length-prefixed frames over a
+local TCP socket between the router process and each replica worker.
+
+PR 14's "fleet" was N threads in one interpreter sharing one jax
+runtime — the scale-out leg measured 0.89×, not 2× (threads contend on
+the runtime and the GIL).  This module is the explicit transport that
+promotes replicas to real OS processes (the serving analogue of the
+SPMD→MPMD promotion in arxiv 2412.14374): the router keeps the shared
+SLO-class queue, admission, deadlines, and futures — a replica process
+is *only* an engine behind a socket, so every queue/shed/deadline
+semantic stays exactly where PR 14 put it.
+
+**Frame format** (one frame per message, both directions)::
+
+    !I  header_len      (4 bytes, big-endian)
+    !I  body_len        (4 bytes, big-endian)
+    header_len bytes    UTF-8 JSON header
+    body_len   bytes    raw binary body (ndarray bytes, or empty)
+
+**Ops** (header ``{"op": ...}``; every request gets exactly one reply):
+
+====================  ===================================================
+``submit``            body = one coalesced batch (C-order ndarray bytes,
+                      shape/dtype in the header); reply ``result`` with
+                      the logits as body, or ``error`` (typed name +
+                      message, no body)
+``health``            liveness probe; reply carries pid, state,
+                      dispatches, and the worker's beat age
+``drain``             finish the in-flight dispatch, ack, then exit 0 —
+                      the deliberate drain (supervisor does not restart
+                      a clean exit)
+``stats``             the engine's counter dict (compiles / cache hits /
+                      bucket counts)
+``shutdown``          ack then exit 0 without draining (close path)
+====================  ===================================================
+
+**Ports are deterministic per replica** so N same-host processes never
+collide: request port ``port_base + rid`` (or ephemeral when
+``port_base`` is 0 — the worker reports the bound port through its
+handshake file), OpenMetrics exporter port ``metrics_base + 1 + rid``
+(the router's own exporter keeps ``metrics_base + 0``, matching
+``obs.start_exporter``'s ``port + process_index`` convention).  Device
+sets are rendered per process the same way: ``JAX_PLATFORMS`` plus the
+platform's visible-devices variable, so two replicas on one host can own
+disjoint accelerators.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+import numpy as np
+
+_LEN = struct.Struct("!II")
+# one frame must never be mistaken for unbounded garbage: a header or
+# body past this is a protocol error, not a big batch (the largest legal
+# batch — bucket 256 of 224px float32 — is ~154 MB, far under this)
+MAX_FRAME = 1 << 30
+
+HOST = "127.0.0.1"
+
+
+class FleetTransportError(ConnectionError):
+    """Torn frame, oversized frame, or a peer that vanished mid-message."""
+
+
+# ----------------------------------------------------------------- ports
+
+
+def replica_port(port_base: int, rid: int) -> int:
+    """Deterministic per-replica request port: ``base + rid`` (0 stays 0
+    = bind ephemeral and report through the handshake file)."""
+    base = int(port_base or 0)
+    return 0 if base <= 0 else base + int(rid)
+
+
+def replica_metrics_port(metrics_base: int, rid: int) -> int:
+    """Deterministic per-replica exporter port: the router keeps
+    ``base + 0`` (process 0 in ``start_exporter``'s convention), replica
+    ``rid`` listens on ``base + 1 + rid`` — N processes stop colliding
+    on one ``--metrics-port``.  0 = exporter off."""
+    base = int(metrics_base or 0)
+    return 0 if base <= 0 else base + 1 + int(rid)
+
+
+def render_worker_env(
+    base_env: dict, rid: int, *, platform: str | None = None,
+    visible_devices=None,
+) -> dict:
+    """The per-process device set, as environment: pin the jax platform
+    and (when a device split is given) the platform's visible-devices
+    variable — each replica process owns its slice of the host's
+    accelerators instead of N processes all grabbing device 0."""
+    env = dict(base_env)
+    if platform:
+        env["JAX_PLATFORMS"] = str(platform)
+    if visible_devices is not None:
+        devs = ",".join(str(d) for d in visible_devices)
+        plat = (platform or env.get("JAX_PLATFORMS") or "").lower()
+        if plat.startswith("tpu"):
+            env["TPU_VISIBLE_CHIPS"] = devs
+        else:
+            # the CUDA spelling is also what ROCm's jax port reads
+            env["CUDA_VISIBLE_DEVICES"] = devs
+    return env
+
+
+# ---------------------------------------------------------------- frames
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise FleetTransportError(
+                f"peer closed mid-frame ({len(buf)}/{n} bytes)"
+            )
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
+    """One frame out: lengths, JSON header, raw body."""
+    raw = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    sock.sendall(_LEN.pack(len(raw), len(body)))
+    sock.sendall(raw)
+    if body:
+        sock.sendall(body)
+
+
+def recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
+    """One frame in: ``(header, body)``.  Raises
+    :class:`FleetTransportError` on a torn or oversized frame."""
+    hlen, blen = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if hlen > MAX_FRAME or blen > MAX_FRAME:
+        raise FleetTransportError(
+            f"oversized frame (header {hlen}, body {blen} bytes)"
+        )
+    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    body = _recv_exact(sock, blen) if blen else b""
+    return header, body
+
+
+def encode_array(arr) -> tuple[dict, bytes]:
+    """An ndarray as ``(meta, bytes)`` — C-order raw bytes, shape and
+    dtype in the meta (rides the message header)."""
+    a = np.ascontiguousarray(arr)
+    return {"shape": list(a.shape), "dtype": str(a.dtype)}, a.tobytes()
+
+
+def decode_array(meta: dict, body: bytes) -> np.ndarray:
+    shape = tuple(int(s) for s in meta["shape"])
+    arr = np.frombuffer(body, dtype=np.dtype(meta["dtype"]))
+    expect = int(np.prod(shape)) if shape else 1
+    if arr.size != expect:
+        raise FleetTransportError(
+            f"body size {arr.size} != shape {shape} ({expect} elements)"
+        )
+    return arr.reshape(shape)
+
+
+# ---------------------------------------------------------------- client
+
+
+class ReplicaClient:
+    """The router-side connection to one replica worker.
+
+    One socket, one RPC at a time (a lock serializes — the router's
+    per-replica dispatcher is single-threaded anyway, the lock guards
+    the supervisor's concurrent ``health()`` probes).  Every call
+    raises :class:`FleetTransportError` when the worker is gone; the
+    caller (``ProcessReplica``) requeues in-flight work and waits for
+    the supervisor's next incarnation.
+    """
+
+    def __init__(
+        self, port: int, *, host: str = HOST, connect_timeout_s: float = 5.0,
+        rpc_timeout_s: float = 600.0,
+    ) -> None:
+        self.host = host
+        self.port = int(port)
+        self._lock = threading.Lock()
+        try:
+            self._sock = socket.create_connection(
+                (host, self.port), timeout=connect_timeout_s
+            )
+            self._sock.settimeout(rpc_timeout_s)
+            # request/response batches are latency-bound: don't nagle
+            self._sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+        except OSError as e:
+            raise FleetTransportError(
+                f"connect to replica on :{self.port} failed: {e}"
+            ) from e
+
+    def rpc(self, header: dict, body: bytes = b"") -> tuple[dict, bytes]:
+        with self._lock:
+            try:
+                send_msg(self._sock, header, body)
+                return recv_msg(self._sock)
+            except (OSError, ValueError) as e:
+                raise FleetTransportError(
+                    f"rpc {header.get('op')!r} to :{self.port} failed: {e}"
+                ) from e
+
+    # -- typed ops ------------------------------------------------------
+
+    def submit_batch(self, images: np.ndarray) -> np.ndarray:
+        meta, body = encode_array(images)
+        reply, rbody = self.rpc({"op": "submit", **meta}, body)
+        if reply.get("op") == "error":
+            # the worker survived but the dispatch failed (engine error):
+            # surface it typed so the batch fails without killing the
+            # replica — exactly the thread path's dispatch_batch contract
+            raise RuntimeError(
+                f"{reply.get('etype', 'Error')}: {reply.get('error', '?')}"
+            )
+        return decode_array(reply, rbody)
+
+    def health(self) -> dict:
+        reply, _ = self.rpc({"op": "health"})
+        return reply
+
+    def stats(self) -> dict:
+        reply, _ = self.rpc({"op": "stats"})
+        return reply.get("stats", {})
+
+    def drain(self) -> dict:
+        reply, _ = self.rpc({"op": "drain"})
+        return reply
+
+    def shutdown(self) -> None:
+        try:
+            self.rpc({"op": "shutdown"})
+        except FleetTransportError:
+            pass  # it shut down before acking: mission accomplished
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -54,11 +54,33 @@ from .batcher import (
 from .metrics import latency_summary_ms
 
 
+def fold_seed(seed: int, *parts) -> int:
+    """Deterministically fold distinguishing parts (replica index, leg
+    name, attempt number, ...) into a base seed.
+
+    Same-host multi-process serving made the collision concrete: N
+    workers or N bench legs all seeded with the bare ``--seed`` replay
+    ONE request/arrival stream — every load generator offers identical
+    Poisson gaps, every pool serves identical images, and the capture
+    measures lockstep replicas instead of independent ones.  Stable
+    across runs (hashlib, not ``hash()`` — PYTHONHASHSEED-proof)."""
+    import hashlib
+
+    h = hashlib.blake2s(digest_size=4)
+    h.update(str(int(seed)).encode())
+    for p in parts:
+        h.update(b"\x1f")
+        h.update(str(p).encode())
+    return int.from_bytes(h.digest(), "big")
+
+
 def request_pool(
-    n: int, image_size: int = 32, seed: int = 0
+    n: int, image_size: int = 32, seed: int = 0, fold=(),
 ) -> np.ndarray:
-    """A pool of synthetic uint8 request images the generators cycle over."""
-    rng = np.random.default_rng(seed)
+    """A pool of synthetic uint8 request images the generators cycle
+    over.  ``fold`` mixes distinguishing parts into the seed (see
+    :func:`fold_seed`) so per-replica / per-leg pools differ."""
+    rng = np.random.default_rng(fold_seed(seed, *fold) if fold else seed)
     return rng.integers(
         0, 256, size=(n, image_size, image_size, 3), dtype=np.uint8
     )

@@ -38,6 +38,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from pathlib import Path
 
 import numpy as np
@@ -47,6 +48,11 @@ from ..obs.metrics import Histogram, histogram_summary
 # past this many samples per series, switch to reservoir sampling; 8192
 # keeps p99 of a uniform sample within ~±1.5% rank error
 RESERVOIR_CAP = 8192
+# arrival sketch: newest N admission timestamps per class (the
+# autoscaler reads a sliding window off the tail, so older entries are
+# dead weight — a bounded deque, not a reservoir, because ORDER matters
+# for interarrival ca²)
+ARRIVAL_CAP = 8192
 # default seconds between periodic `metrics` bus events (live SLO feed)
 EMIT_EVERY_S_DEFAULT = 5.0
 
@@ -213,6 +219,15 @@ class ServeMetrics:
         self._registry = registry
         self.classes = dict(classes) if classes else {}
         self._class_stats: dict[str, _ClassStats] = {}
+        # the autoscaler's inputs: admission timestamps (global + per
+        # class) for λ/ca², and a per-dispatch service sketch (exact
+        # Welford moments for mean/cv², reservoir for the p99 tail)
+        self._arrivals: dict[str, deque] = {"*": deque(maxlen=ARRIVAL_CAP)}
+        self._service = _Reservoir()
+        self._svc_n = 0
+        self._svc_mean = 0.0
+        self._svc_m2 = 0.0
+        self._svc_batch_sum = 0.0
 
     # back-compat views: callers/tests read the raw sample lists by name
     @property
@@ -288,6 +303,77 @@ class ServeMetrics:
             # a queued request failed before dispatch is a burned
             # admission — shed, whatever its failure type
             self._reg_shed_total.inc()
+
+    def record_arrival(self, cls: str | None = None) -> None:
+        """One ADMITTED request (submit succeeded) — the arrival-rate /
+        interarrival-ca² sketch the queueing autoscaler fits."""
+        now = time.monotonic()
+        name = cls or "default"
+        with self._lock:
+            self._arrivals["*"].append(now)
+            dq = self._arrivals.get(name)
+            if dq is None:
+                dq = self._arrivals[name] = deque(maxlen=ARRIVAL_CAP)
+            dq.append(now)
+
+    def record_service(self, service_s: float, batch_size: int) -> None:
+        """One completed DISPATCH (engine time for one coalesced batch)
+        — the service-time sketch (mean, cv², p99, mean batch) the
+        queueing autoscaler fits."""
+        s = float(service_s)
+        with self._lock:
+            self._service.add(s)
+            self._svc_n += 1
+            d = s - self._svc_mean
+            self._svc_mean += d / self._svc_n
+            self._svc_m2 += d * (s - self._svc_mean)
+            self._svc_batch_sum += int(batch_size)
+
+    def arrival_stats(
+        self, window_s: float = 30.0, cls: str | None = None,
+    ) -> dict:
+        """Arrival rate λ (req/s) and interarrival squared-CV over the
+        trailing ``window_s`` (``cls=None`` = all classes)."""
+        now = time.monotonic()
+        cutoff = now - float(window_s)
+        with self._lock:
+            dq = self._arrivals.get(cls or "*")
+            times = [t for t in dq if t >= cutoff] if dq else []
+        n = len(times)
+        if n < 2:
+            return {
+                "n": n, "lam_rps": n / float(window_s), "ca2": 1.0,
+            }
+        gaps = np.diff(np.asarray(times, np.float64))
+        span = times[-1] - times[0]
+        lam = (n - 1) / span if span > 0 else n / float(window_s)
+        mean_gap = float(gaps.mean())
+        ca2 = (
+            float(gaps.var() / (mean_gap * mean_gap))
+            if mean_gap > 0 else 1.0
+        )
+        return {"n": n, "lam_rps": lam, "ca2": ca2}
+
+    def service_stats(self) -> dict:
+        """The per-dispatch service sketch: exact mean/cv² (Welford),
+        reservoir p99, mean coalesced batch size."""
+        with self._lock:
+            n = self._svc_n
+            if not n:
+                return {
+                    "n": 0, "mean_s": 0.0, "cv2": 1.0, "p99_s": 0.0,
+                    "mean_batch": 1.0,
+                }
+            mean = self._svc_mean
+            var = self._svc_m2 / n if n > 1 else 0.0
+            values = list(self._service.values)
+            batch = self._svc_batch_sum / n
+        p99 = float(np.percentile(np.asarray(values), 99.0))
+        cv2 = var / (mean * mean) if mean > 0 else 1.0
+        return {
+            "n": n, "mean_s": mean, "cv2": cv2, "p99_s": p99,
+            "mean_batch": batch,
+        }
 
     def record_error(self) -> None:
         """One failed BATCH (engine exception) — the dispatch-level tally."""

@@ -86,6 +86,8 @@ _ROUTER_SEQ = itertools.count()
 class Replica:
     """One engine + one worker thread pulling from the shared queue."""
 
+    transport = "thread"
+
     def __init__(
         self,
         rid: int,
@@ -117,6 +119,9 @@ class Replica:
         self._last_beat_event = 0.0
         self._lock = threading.Lock()
         self._inflight: list = []
+        # per-replica per-class latency sample (requests THIS replica
+        # resolved) — the run_report --serve per-replica table's p99
+        self._class_lat: dict[str, list] = {}
         self._thread = threading.Thread(
             target=self._run, name=f"serve-replica-{self.rid}", daemon=True
         )
@@ -124,7 +129,8 @@ class Replica:
     # ---------------------------------------------------------- lifecycle
 
     def start(self) -> "Replica":
-        self._thread.start()
+        if self._thread.ident is None:  # idempotent: never started yet
+            self._thread.start()
         return self
 
     def _transition(self, state: str, **payload) -> None:
@@ -135,6 +141,9 @@ class Replica:
                 return  # a drain issued during warmup sticks
             self.state = state
         if self.bus is not None:
+            payload.setdefault("transport", self.transport)
+            if state == STOPPED:
+                payload.setdefault("classes", self.class_latency_ms())
             self.bus.emit(
                 REPLICA_KIND, replica=self.rid, state=state, **payload
             )
@@ -149,9 +158,33 @@ class Replica:
             self._last_beat_event = now
             self.bus.emit(
                 REPLICA_KIND, replica=self.rid, state=self.state,
-                beat=True, dispatches=self.dispatches, routed=self.routed,
+                beat=True, transport=self.transport,
+                dispatches=self.dispatches, routed=self.routed,
                 queue_depth=self.queue.depth,
             )
+
+    def _note_done(self, fut) -> None:
+        """Fold one completed future into this replica's per-class
+        latency sample (bounded: newest 2048 per class)."""
+        lat = fut.latency_s
+        if lat is None:
+            return
+        with self._lock:
+            lane = self._class_lat.setdefault(fut.cls, [])
+            lane.append(lat)
+            if len(lane) > 2048:
+                del lane[: len(lane) - 1024]
+
+    def class_latency_ms(self) -> dict:
+        """``{class: {n, p99_ms}}`` of what this replica resolved."""
+        from .metrics import latency_summary_ms
+
+        with self._lock:
+            lanes = {c: list(v) for c, v in self._class_lat.items()}
+        return {
+            c: {"n": len(v), "p99_ms": latency_summary_ms(v)["p99"]}
+            for c, v in lanes.items()
+        }
 
     def _run(self) -> None:
         try:
@@ -212,7 +245,8 @@ class Replica:
             # above the worst-case single dispatch INCLUDING a compile —
             # see ServeRouter's docstring
             self._beat()
-            dispatch_batch(self.engine, batch, self.metrics)
+            for fut in dispatch_batch(self.engine, batch, self.metrics):
+                self._note_done(fut)
             with self._lock:
                 self._inflight = []
                 self.dispatches += 1
@@ -268,10 +302,17 @@ class Replica:
     def join(self, timeout: float = 30.0) -> None:
         self._thread.join(timeout)
 
+    def engine_stats(self) -> dict | None:
+        """The engine's counter dict, however the engine is reached —
+        the thread transport reads it directly; the process transport
+        caches the worker's last stats RPC."""
+        return self.engine.stats() if self.engine is not None else None
+
     def describe(self) -> dict:
         with self._lock:
             return {
                 "state": self.state,
+                "transport": self.transport,
                 "dispatches": self.dispatches,
                 "routed": self.routed,
                 "error": self.error,
@@ -318,12 +359,23 @@ class ServeRouter:
         plan: dict | None = None,
         start: bool = True,
         monitor=None,
+        transport: str = "thread",
+        process_spec: dict | None = None,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"router needs >= 1 replica, got {replicas}")
         if mode not in ("continuous", "bucketed"):
             raise ValueError(
                 f"mode must be 'continuous' or 'bucketed', got {mode!r}"
+            )
+        if transport not in ("thread", "process"):
+            raise ValueError(
+                f"transport must be 'thread' or 'process', got {transport!r}"
+            )
+        if transport == "process" and not process_spec:
+            raise ValueError(
+                "transport='process' needs a process_spec (fleet_dir + "
+                "worker hparams — see serve.fleet.replica)"
             )
         self.classes = dict(classes) if classes else default_classes()
         self.metrics = metrics if metrics is not None else ServeMetrics(
@@ -346,13 +398,14 @@ class ServeRouter:
         self.emit_every_s = float(emit_every_s)
         self._engine_factory = engine_factory
         self._closed = False
+        self.transport = transport
+        self.process_spec = dict(process_spec) if process_spec else None
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.warm_buckets = warm_buckets
+        self.autoscaler = None  # attach_autoscaler wires the live loop
+        self._scale_every_s = 1.0
         self.replicas = [
-            Replica(
-                rid, engine_factory, self.queue, self.metrics,
-                mode=mode, max_wait_s=float(max_wait_ms) / 1e3,
-                warm_buckets=warm_buckets, bus=bus,
-            )
-            for rid in range(int(replicas))
+            self._make_replica(rid) for rid in range(int(replicas))
         ]
         self._ticker = threading.Thread(
             target=self._tick_loop, name="serve-router", daemon=True
@@ -363,6 +416,7 @@ class ServeRouter:
                 "router": self.seq,
                 "replicas": len(self.replicas),
                 "mode": mode,
+                "transport": transport,
                 "classes": {
                     name: slo.describe() for name, slo in self.classes.items()
                 },
@@ -375,9 +429,33 @@ class ServeRouter:
 
     # ---------------------------------------------------------- lifecycle
 
+    def _make_replica(self, rid: int):
+        """One replica on the configured transport — the ONLY place the
+        two substrates diverge; everything downstream sees the Replica
+        interface."""
+        if self.transport == "process":
+            from .fleet.replica import ProcessReplica
+
+            return ProcessReplica(
+                rid, self.process_spec, self.queue, self.metrics,
+                mode=self.mode, max_wait_s=self.max_wait_s,
+                warm_buckets=self.warm_buckets, bus=self.bus,
+            )
+        return Replica(
+            rid, self._engine_factory, self.queue, self.metrics,
+            mode=self.mode, max_wait_s=self.max_wait_s,
+            warm_buckets=self.warm_buckets, bus=self.bus,
+        )
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Wire the queueing-aware autoscaler into the ticker: one
+        sizing step per ``_scale_every_s`` (it carries its own cooldown
+        and hysteresis)."""
+        self.autoscaler = autoscaler
+
     def start(self) -> "ServeRouter":
         for r in self.replicas:
-            if not r._thread.is_alive() and r.state == STARTING:
+            if r.state == STARTING:
                 r.start()
         if not self._ticker.is_alive():
             self._ticker.start()
@@ -437,21 +515,49 @@ class ServeRouter:
         persisted cache when one is wired) — the router-side half of a
         flash-crowd response."""
         new_ids = []
+        if warm_buckets is not None:
+            self.warm_buckets = warm_buckets
         for _ in range(int(n)):
             rid = len(self.replicas)
-            r = Replica(
-                rid, self._engine_factory, self.queue, self.metrics,
-                mode=self.mode, max_wait_s=self.replicas[0].max_wait_s,
-                warm_buckets=(
-                    warm_buckets if warm_buckets is not None
-                    else self.replicas[0].warm_buckets
-                ),
-                bus=self.bus,
-            )
+            r = self._make_replica(rid)
             self.replicas.append(r)
             r.start()
             new_ids.append(rid)
         return new_ids
+
+    def active_replicas(self) -> int:
+        """Replicas currently serving or coming up — the autoscaler's
+        notion of fleet size (a draining/stopped/dead replica is already
+        on its way out and must not mask a needed scale-up)."""
+        return sum(
+            r.state in (STARTING, READY) for r in self.replicas
+        )
+
+    def scale_down(self, n: int = 1) -> list[int]:
+        """Drain the ``n`` newest active replicas (highest rid first —
+        LIFO keeps the original fleet stable and retires flash-crowd
+        surge capacity).  Deliberate drains: in-flight completes, queued
+        work stays shared.  Returns the drained rids."""
+        drained = []
+        for r in reversed(self.replicas):
+            if len(drained) >= int(n):
+                break
+            if r.state in (STARTING, READY):
+                r.drain()
+                drained.append(r.rid)
+        return drained
+
+    def scale_to(self, m: int) -> dict:
+        """Resize the active fleet to ``m`` replicas (the autoscaler's
+        apply path): grow with ``scale_up``, shrink with ``scale_down``.
+        Returns ``{"added": [...], "drained": [...]}``."""
+        current = self.active_replicas()
+        delta = int(m) - current
+        if delta > 0:
+            return {"added": self.scale_up(delta), "drained": []}
+        if delta < 0:
+            return {"added": [], "drained": self.scale_down(-delta)}
+        return {"added": [], "drained": []}
 
     def rewarm(self, buckets=None) -> dict:
         """The ``rewarm_serve`` policy action, fleet-wide: every ready
@@ -460,6 +566,12 @@ class ServeRouter:
         into the ``policy`` event's ``completed`` payload."""
         out = {}
         for r in self.ready_replicas():
+            if r.engine is None:
+                # process transport: the worker owns its engine; a
+                # restart (which re-warms from the persisted cache) is
+                # the rewarm story there — recorded, not silently eaten
+                out[str(r.rid)] = {"skipped": "process-transport replica"}
+                continue
             try:
                 out[str(r.rid)] = r.engine.rewarm(buckets)
             except Exception as e:  # one replica's failure isn't the fleet's
@@ -514,10 +626,21 @@ class ServeRouter:
 
     def _tick_loop(self) -> None:
         last_emit = time.monotonic()
+        last_scale = last_emit
         while not self._closed:
             time.sleep(min(0.25, self.emit_every_s))
             self.health_check()
             now = time.monotonic()
+            if (
+                self.autoscaler is not None
+                and now - last_scale >= self._scale_every_s
+                and not self.queue.closed
+            ):
+                last_scale = now
+                try:
+                    self.autoscaler.step(self)
+                except Exception:  # sizing must never kill the ticker
+                    pass
             if now - last_emit >= self.emit_every_s:
                 last_emit = now
                 self.emit_route_event()
@@ -551,12 +674,16 @@ class ServeRouter:
             "replicas": {str(r.rid): r.describe() for r in self.replicas},
             "queue_depth": self.queue.depth,
             "mode": self.mode,
+            "transport": self.transport,
         }
         # fold the per-replica engine counters (every replica that built
         # an engine, whatever its current state — a closed router's
-        # stats must still report the session's engine counters)
+        # stats must still report the session's engine counters); the
+        # engine_stats seam hides HOW the engine is reached (in-process
+        # attribute vs the process transport's cached stats RPC)
         engines = [
-            r.engine.stats() for r in self.replicas if r.engine is not None
+            s for s in (r.engine_stats() for r in self.replicas)
+            if s is not None
         ]
         if engines:
             out["engine"] = {

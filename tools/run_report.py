@@ -953,12 +953,102 @@ def serve_class_table(events: list[dict]) -> dict[str, dict]:
     return table
 
 
+def serve_replica_table(events: list[dict]) -> dict[str, dict]:
+    """Per-replica lifecycle totals merged from the ``replica`` events
+    of every process in the stream (the router's dispatcher-side events
+    at process_index 0 and — process transport — each worker's own at
+    process_index 1+rid).
+
+    Counters (dispatches/routed/restarts) are cumulative on their
+    events, so the row keeps the MAX seen; ``drains``/``deaths`` count
+    transitions; ``classes`` is the last per-class latency payload a
+    transition carried (the stopped event's ``{cls: {n, p99_ms}}``)."""
+    table: dict[str, dict] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "replica":
+            continue
+        p = _payload(ev)
+        rid = p.get("replica")
+        if rid is None:
+            continue
+        row = table.setdefault(str(rid), {
+            "transport": None, "pid": None, "restarts": 0, "drains": 0,
+            "deaths": 0, "dispatches": 0, "routed": 0, "state": None,
+            "classes": {},
+        })
+        if p.get("transport"):
+            row["transport"] = p["transport"]
+        if p.get("pid"):
+            row["pid"] = p["pid"]
+        for k in ("dispatches", "routed"):
+            if p.get(k) is not None:
+                row[k] = max(row[k], int(p[k]))
+        for k in ("restarts", "attempt"):  # supervisor lifecycle events
+            if p.get(k):
+                row["restarts"] = max(row["restarts"], int(p[k]))
+        if p.get("restart"):
+            row["restarts"] = max(row["restarts"], int(p["restart"]))
+        state = p.get("state")
+        if not p.get("beat") and state:
+            if state == "draining":
+                row["drains"] += 1
+            if state == "dead":
+                row["deaths"] += 1
+            row["state"] = state
+        if p.get("classes"):
+            row["classes"] = p["classes"]
+    return table
+
+
+def serve_scale_mismatches(events: list[dict]) -> list[str]:
+    """Scale decisions the fleet never honored: for every APPLIED
+    ``serve_scale`` event, each added rid must show a ``ready`` replica
+    event and each drained rid a ``stopped``/``dead`` one somewhere in
+    the stream — a decision that targeted a fleet size the replicas
+    never reached is an autoscaler/fleet disagreement worth an exit 1."""
+    added: set = set()
+    drained: set = set()
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "serve_scale":
+            continue
+        p = _payload(ev)
+        if p.get("state") != "applied":
+            continue
+        added.update(str(r) for r in (p.get("added") or ()))
+        drained.update(str(r) for r in (p.get("drained") or ()))
+    if not added and not drained:
+        return []
+    seen: dict[str, set] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "replica":
+            continue
+        p = _payload(ev)
+        rid = p.get("replica")
+        if rid is not None and p.get("state"):
+            seen.setdefault(str(rid), set()).add(p["state"])
+    problems = []
+    for rid in sorted(added):
+        if "ready" not in seen.get(rid, set()):
+            problems.append(
+                f"scale-up added replica {rid} but it never went ready"
+            )
+    for rid in sorted(drained):
+        if not ({"stopped", "dead"} & seen.get(rid, set())):
+            problems.append(
+                f"scale-down drained replica {rid} but it never stopped"
+            )
+    return problems
+
+
 def serve_report(path: str | Path, out=print) -> int:
-    """The ``--serve`` view: the per-class SLO attainment table from the
-    event stream alone.  Exit 0 when every class with a declared target
-    meets it (including when there are no ``serve_route`` events — a
-    run that never served is not unhealthy), 1 when any class is below
-    its target, 2 when ``path`` holds no events whatsoever."""
+    """The ``--serve`` view: the per-class SLO attainment table + the
+    per-replica lifecycle table from the event stream alone.  Exit 0
+    when every class with a declared target meets it AND every applied
+    scale decision's fleet change actually came up (including when there
+    are no ``serve_route`` events — a run that never served is not
+    unhealthy), 1 when any class is below its target or a scale decision
+    disagrees with the replicas that materialized, 2 when ``path`` holds
+    no events whatsoever."""
     events, _files = load_run(path)
     if not events:
         out(f"{path}: no events found")
@@ -1009,6 +1099,31 @@ def serve_report(path: str | Path, out=print) -> int:
             f"{(f'{target * 100:.1f}%' if target else '-'):>7}  "
             + ("BELOW TARGET" if below else "ok")
         )
+    # per-replica lifecycle table: pid/transport/restarts/drains and
+    # what each replica actually resolved, merged from every process's
+    # replica events (the worker files included, process transport)
+    replicas = serve_replica_table(events)
+    if replicas:
+        out("")
+        rheader = (
+            f"{'rid':>4} {'transport':>9} {'pid':>8} {'state':>9} "
+            f"{'restarts':>8} {'drains':>6} {'dispatches':>10} "
+            f"{'routed':>7}  p99 per class"
+        )
+        out(rheader)
+        out("-" * len(rheader))
+        for rid in sorted(replicas, key=lambda r: int(r)):
+            row = replicas[rid]
+            cls = ", ".join(
+                f"{c}={v.get('p99_ms', 0):.0f}ms"
+                for c, v in sorted((row.get("classes") or {}).items())
+            ) or "-"
+            out(
+                f"{rid:>4} {row.get('transport') or '-':>9} "
+                f"{row.get('pid') or '-':>8} {row.get('state') or '-':>9} "
+                f"{row['restarts']:>8} {row['drains']:>6} "
+                f"{row['dispatches']:>10} {row['routed']:>7}  {cls}"
+            )
     # replica lifecycle recap: dead replicas are worth a line even when
     # every SLO held (the fleet absorbed the failure — say so)
     dead = [
@@ -1024,8 +1139,15 @@ def serve_report(path: str | Path, out=print) -> int:
                 f"{p.get('replica')} ({p.get('reason', '?')})" for p in dead
             )
         )
+    # autoscaler/fleet agreement: an applied scale decision whose
+    # added/drained replicas never materialized is a failure even when
+    # every SLO held — the decision record and the fleet disagree
+    mismatches = serve_scale_mismatches(events)
+    for msg in mismatches:
+        out(f"SCALE MISMATCH: {msg}")
+        rc = 1
     if rc:
-        out("one or more classes BELOW their SLO target")
+        out("one or more classes BELOW their SLO target or scale mismatch")
     else:
         out("all SLO targets met")
     return rc
