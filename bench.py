@@ -1449,15 +1449,17 @@ def events_check_rc(ckpt_root: str, require_kinds=()) -> int:
 
 
 def _drive_fleet_gauntlet(
-    ckpt_root: str, proc, driver_log: list, readmit: bool,
+    ckpt_root: str, proc, driver_log: list, readmit,
     timeout: float = 600.0,
 ) -> None:
     """The external environment's script, shared by the resilience and
     chaos legs: SIGKILL host 1 (spot reclaim) once attempt 0 has a
-    verified checkpoint, and — with ``readmit`` — write ``host-1.up``
-    (the SCHEDULER's re-admission interface) once the shrunk attempt's
-    ``run_start`` lands.  Never an operator action: no ``host-i.down``
-    is ever written here."""
+    verified checkpoint, and — with ``readmit`` — signal re-admission
+    once the shrunk attempt's ``run_start`` lands: ``True`` writes
+    ``host-1.up`` directly (the legacy scheduler interface),
+    ``"probe"`` only creates the ``--fleet-probe`` ready file and lets
+    the SchedulerProbe write the marker itself.  Never an operator
+    action: no ``host-i.down`` is ever written here."""
     import os
     import signal as _signal
     import time as _time
@@ -1506,6 +1508,17 @@ def _drive_fleet_gauntlet(
         ),
         "attempt 1 run_start",
     ):
+        return
+    if readmit == "probe":
+        # the residue-closing path: the driver never touches
+        # <ckpt>/fleet/ — it creates the PROBE's ready file (a k8s
+        # node-ready / GCE guest-attribute stand-in) and --fleet-probe
+        # turns that into host-1.up on the supervisor's own cadence
+        with open(os.path.join(ckpt_root, "probe-ready-1"), "w"):
+            pass
+        driver_log.append(
+            "scheduler marked host 1 schedulable (probe-ready-1)"
+        )
         return
     with open(os.path.join(ckpt_root, "fleet", "host-1.up"), "w"):
         pass
@@ -1868,6 +1881,9 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
         check_chaos_expectations,
     )
     from distributed_training_comparison_tpu.ops.policy import pending_actions
+    from distributed_training_comparison_tpu.resilience.control import (
+        unapplied_actions,
+    )
 
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools"
@@ -1924,7 +1940,9 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
             cmd += ["--alert", spec]
         for spec in sc["policies"]:
             cmd += ["--policy", spec]
-        cmd += list(sc["extra_args"])
+        # {root} in extra_args resolves to the scenario's ckpt root
+        # ({host} survives untouched for the SchedulerProbe itself)
+        cmd += [a.replace("{root}", root) for a in sc["extra_args"]]
         env = dict(os.environ)
         env.update(sc["env"])
 
@@ -1932,12 +1950,16 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
 
         def drive(proc, script=sc["driver"]) -> None:
             # the external environment only: spot reclaim (SIGKILL) and
-            # the scheduler's re-admission marker — never an operator
-            # action (no host-i.down is ever written here)
+            # the scheduler's re-admission signal — never an operator
+            # action (no host-i.down is ever written here; the probe
+            # variant writes no marker at all)
             if script is not None:
                 _drive_fleet_gauntlet(
                     root, proc, driver_log,
-                    readmit=script == "kill_and_readmit_host1",
+                    readmit=(
+                        "probe" if script == "probe_readmit_host1"
+                        else script == "kill_and_readmit_host1"
+                    ),
                 )
 
         proc = subprocess.Popen(
@@ -1977,6 +1999,23 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
             if ev.get("kind") == "policy":
                 st = (ev.get("payload") or {}).get("state", "?")
                 policy_states[st] = policy_states.get(st, 0) + 1
+        # the decide->apply trail: every control request's end state,
+        # split by whether the application landed INSIDE an epoch (the
+        # tentpole's chunk boundary) or at the legacy epoch boundary
+        controls_applied = control_mid_epoch = controls_superseded = 0
+        control_ttms: list[float] = []
+        for ev in events:
+            if ev.get("kind") != "control":
+                continue
+            p = ev.get("payload") or {}
+            if p.get("state") == "applied":
+                controls_applied += 1
+                if p.get("mid_epoch"):
+                    control_mid_epoch += 1
+                if isinstance(p.get("ttm_s"), (int, float)):
+                    control_ttms.append(float(p["ttm_s"]))
+            elif p.get("state") == "superseded":
+                controls_superseded += 1
         try:
             with open(goodput_json) as f:
                 gp = json.load(f)
@@ -2009,6 +2048,11 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
             "policy_cooldown": policy_states.get("cooldown", 0),
             "policy_budget": policy_states.get("budget", 0),
             "policy_pending": len(pending_actions(events)),
+            "controls_applied": controls_applied,
+            "control_mid_epoch": control_mid_epoch,
+            "controls_superseded": controls_superseded,
+            "control_ttm_max_s": round(max(control_ttms), 3)
+            if control_ttms else None,
             "crash_dump_evidence": evidence_ok,
             "goodput_frac": gp.get("goodput_frac"),
         }
@@ -2019,6 +2063,12 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
             problems.append(
                 f"{observed['policy_pending']} policy action(s) still "
                 "pending (requested, never completed)"
+            )
+        never_applied = unapplied_actions(events)
+        if never_applied:
+            problems.append(
+                f"{len(never_applied)} acted decision(s) completed with "
+                "no 'applied' control event (decide->apply trail broken)"
             )
         check_rc = events_check_rc(
             root, require_kinds=tuple(sc["require_kinds"])
@@ -2096,6 +2146,211 @@ def bench_chaos(out_path: str = "CHAOS.json", scenarios=None) -> dict:
         raise RuntimeError(
             "chaos gauntlet red: " + "; ".join(failures)
         )
+    return record
+
+
+def bench_control(out_path: str = "BENCH_CONTROL.json") -> dict:
+    """The mid-epoch control-plane leg (the tentpole's scoreboard): the
+    SAME policy rollback decision applied through both boundaries —
+    ``--control-boundary chunk`` (the new control channel, applied at
+    the next chunk boundary inside the epoch) vs ``epoch`` (the legacy
+    request channel, applied at the next epoch boundary) — plus a
+    supervised fleet leg whose ``drain_host`` decision rides the control
+    channel into a clean mid-epoch drain-checkpoint.  The committed
+    record prices time-to-mitigation per decision: ``ttm_s`` (decide →
+    apply wall seconds) and ``steps_since_decide`` (the step distance),
+    with the gate that every CHUNK-boundary application landed within
+    one chunk of its decision — the whole point of the boundary move.
+
+    Sizing: 512 synthetic examples / batch 32 = 16 steps per epoch with
+    ``--device-chunk-steps 2`` — eight poll boundaries per epoch, so the
+    epoch-boundary baseline is measurably (≈8x in steps) slower to
+    mitigate than the chunk path on identical decisions.
+    """
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    platform = jax.devices()[0].platform
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child = os.path.join(repo, "tests", "fleet_pool_worker.py")
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import run_report
+
+    CHUNK = 2
+    base = [
+        "--synthetic-data", "--limit-examples", "512",
+        "--batch-size", "32", "--no-progress", "--eval-step", "1000",
+        "--save-last-min-secs", "0", "--seed", "7",
+        "--device-chunk-steps", str(CHUNK), "--heartbeat-secs", "0.2",
+    ]
+    # a loss spike injected mid-epoch 2 — AFTER the epoch-0/1 verified
+    # saves, so the rollback decision has a target and is eligible for
+    # the chunk boundary (a decision that precedes the first save is
+    # deliberately deferred to the epoch boundary; that path is covered
+    # by the in-process tests, not this scoreboard)
+    spike = "train/loss:p95>50:for=1"
+    rollback_policy = [
+        "--fault-plan", "loss_spike@epoch=2:scale=64:steps=3",
+        "--health-spike-mads", "1e9",
+        "--alert", spike,
+        "--policy", f"{spike} -> rollback:cooldown=9999",
+        "--policy-mode", "act",
+    ]
+    straggler = "step/dispatch_s:p95>30:for=2"
+    legs = {
+        # in-process engine, one rollback decision, applied at the next
+        # CHUNK boundary (mid-epoch) — TTM bounded by one chunk
+        "rollback_chunk": {
+            "argv": base + rollback_policy
+            + ["--epoch", "6", "--control-boundary", "chunk"],
+            "supervised": False,
+            "expect_boundary": "chunk",
+        },
+        # the identical decision through the legacy epoch-boundary
+        # channel — the baseline the tentpole improves on
+        "rollback_epoch": {
+            "argv": base + rollback_policy
+            + ["--epoch", "6", "--control-boundary", "epoch"],
+            "supervised": False,
+            "expect_boundary": "epoch",
+        },
+        # supervised 2-host fleet, persistent straggler: the drain_host
+        # decision writes control-drain.req and the trainer exits
+        # through the proven mid-epoch drain-checkpoint at its next
+        # chunk instead of riding out the SIGTERM grace race
+        "drain_fleet": {
+            "argv": base + [
+                "--supervise", "--fleet-hosts", "2",
+                "--fleet-local-devices", "1", "--fleet-grace-secs", "3",
+                "--fleet-poll-secs", "0.2", "--epoch", "10",
+                "--alert", straggler,
+                "--policy", f"{straggler} -> drain_host:cooldown=120",
+                "--policy-mode", "act",
+            ],
+            "supervised": True,
+            "expect_boundary": None,  # chunk OR the epoch's final chunk
+        },
+    }
+
+    rows: dict[str, dict] = {}
+    failures: list[str] = []
+    worst_rc = 0
+    for name, leg in legs.items():
+        root = tempfile.mkdtemp(prefix=f"control-{name}-")
+        cmd = [sys.executable, child, *leg["argv"], "--ckpt-path", root]
+        env = dict(os.environ)
+        if leg["supervised"]:
+            from distributed_training_comparison_tpu.resilience.faults import (
+                EMU_SLOW_DISPATCH_ENV,
+            )
+
+            env[EMU_SLOW_DISPATCH_ENV] = "60"
+        proc = subprocess.run(
+            cmd, cwd=repo, env=env, capture_output=True, text=True,
+            timeout=900,
+        )
+        events, _files = run_report.load_run(root)
+        applied = [
+            (ev.get("payload") or {})
+            for ev in events
+            if ev.get("kind") == "control"
+            and (ev.get("payload") or {}).get("state") == "applied"
+        ]
+        check_rc = events_check_rc(root, require_kinds=("policy", "control"))
+        worst_rc = max(worst_rc, check_rc)
+        row = {
+            "final_rc": proc.returncode,
+            "controls_applied": len(applied),
+            "applications": [
+                {
+                    "action": p.get("action"),
+                    "verb": p.get("verb"),
+                    "boundary": p.get("boundary"),
+                    "mid_epoch": p.get("mid_epoch"),
+                    "ttm_s": p.get("ttm_s"),
+                    "steps_since_decide": p.get("steps_since_decide"),
+                }
+                for p in applied
+            ],
+            "events_check_rc": check_rc,
+        }
+        problems: list[str] = []
+        if proc.returncode != 0:
+            problems.append(f"final_rc={proc.returncode}")
+        if not applied:
+            problems.append("no applied control event")
+        if check_rc != 0:
+            problems.append(f"events_check_rc={check_rc}")
+        want = leg["expect_boundary"]
+        if want is not None and any(
+            p.get("boundary") != want for p in applied
+        ):
+            problems.append(
+                f"boundary mismatch (wanted {want}): "
+                f"{[p.get('boundary') for p in applied]}"
+            )
+        # THE gate: a chunk-boundary application must land within one
+        # chunk of its decision's step position
+        for p in applied:
+            ssd = p.get("steps_since_decide")
+            if p.get("boundary") == "chunk" and isinstance(ssd, int) \
+                    and ssd > CHUNK:
+                problems.append(
+                    f"chunk-boundary apply took {ssd} steps (> one "
+                    f"{CHUNK}-step chunk)"
+                )
+        row["green"] = not problems
+        row["problems"] = problems
+        rows[name] = row
+        emit_progress(f"control/{name}", {
+            "rc": proc.returncode, "green": row["green"],
+            "applications": row["applications"], "problems": problems,
+        })
+        if problems:
+            failures.append(
+                f"{name}: {problems} (stderr tail: "
+                f"{(proc.stderr or '')[-800:]})"
+            )
+
+    # the headline: identical decision, steps-to-mitigation both ways
+    def _ssd(name):
+        apps = rows[name]["applications"]
+        return apps[0]["steps_since_decide"] if apps else None
+
+    record = {
+        "metric": "control_ttm",
+        "platform": platform,
+        "chunk_steps": CHUNK,
+        "steps_per_epoch": 16,
+        "legs": rows,
+        "steps_to_mitigation": {
+            "chunk": _ssd("rollback_chunk"),
+            "epoch": _ssd("rollback_epoch"),
+        },
+        "green": not failures,
+        "events_check_rc": worst_rc,
+        "note": (
+            "Identical spike-triggered rollback decision applied through "
+            "both boundaries; steps_since_decide counts chunk-boundary "
+            "marks between the decision and its application. The fleet "
+            "leg's drain_host rides control-drain.req into a clean "
+            "mid-epoch drain-checkpoint (CPU capture: rank 1 is the "
+            "fleet_pool_worker host emulation)."
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "control_ttm",
+        "green": record["green"],
+        "steps_to_mitigation": record["steps_to_mitigation"],
+        "full_record": out_path,
+    }))
+    if failures:
+        raise RuntimeError("control leg red: " + "; ".join(failures))
     return record
 
 
@@ -3944,6 +4199,8 @@ if __name__ == "__main__":
         bench_resilience()
     elif "--chaos" in sys.argv:
         bench_chaos()
+    elif "--control" in sys.argv:
+        bench_control()
     elif "--health" in sys.argv:
         bench_health()
     elif "--overlap" in sys.argv:

@@ -718,6 +718,20 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "paying a fast poll on a healthy fleet",
     )
     parser.add_argument(
+        "--fleet-probe",
+        type=str,
+        default="",
+        metavar="SPEC",
+        help="Scheduler re-admission probe, polled by the fleet "
+        "supervisor for every LOST host: 'file:PATH' (slot schedulable "
+        "when PATH exists; {host} substituted) or 'exec:CMD' (shell "
+        "command, exit 0 = schedulable; {host} substituted, else the "
+        "host index is appended). A schedulable answer writes the same "
+        "host-i.up marker an operator would; probe infrastructure "
+        "failures degrade to the manual marker path with one warning. "
+        "Default '' = markers only",
+    )
+    parser.add_argument(
         "--max-restarts",
         type=int,
         default=3,
@@ -1003,6 +1017,21 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "rate-limit re-warms, not lose them forever)",
     )
     parser.add_argument(
+        "--control-boundary",
+        type=str,
+        default="chunk",
+        choices=["chunk", "epoch"],
+        help="Where supervisor/policy decisions APPLY: 'chunk' (default) "
+        "lands rollback/abort/drain_host/replan requests as durable "
+        "control-*.req files the trainer consumes at every chunk "
+        "boundary — the same poll site as mid-epoch preemption, so "
+        "time-to-mitigation is bounded by one chunk, not one epoch; "
+        "'epoch' keeps the legacy policy-*.req channel applied at the "
+        "next epoch boundary (the PR-12 behavior, kept as the bench "
+        "baseline). Every application emits a 'control' event carrying "
+        "decide->apply latency; see run_report --policy",
+    )
+    parser.add_argument(
         "--health-phase-baselines",
         action=argparse.BooleanOptionalAction,
         default=True,
@@ -1097,6 +1126,18 @@ def load_config(
             "--fleet-hosts re-renders --world-size/--rank per attempt; "
             "do not pass --world-size with the elastic pool"
         )
+    if args.fleet_probe:
+        kind, _, arg = args.fleet_probe.partition(":")
+        if kind not in ("exec", "file") or not arg:
+            parser.error(
+                f"--fleet-probe must be 'exec:CMD' or 'file:PATH', "
+                f"got {args.fleet_probe!r}"
+            )
+        if args.fleet_hosts <= 1:
+            parser.error(
+                "--fleet-probe is the elastic pool's re-admission "
+                "signal; it needs --fleet-hosts > 1"
+            )
     if args.flight_recorder_size < 1:
         parser.error(
             f"--flight-recorder-size must be >= 1, got {args.flight_recorder_size}"

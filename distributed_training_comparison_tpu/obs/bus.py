@@ -109,6 +109,13 @@ KNOWN_KINDS = {
     # rows, whether it applied, held (cooldown / scale-down hysteresis),
     # or was forced by the `scale_serve` autopilot action
     "serve_scale",
+    # mid-epoch control plane (resilience/control): one event per control
+    # request reaching its end state — applied at a chunk/epoch boundary,
+    # superseded (stale attempt-scoped drain discarded), or expired (run
+    # ended with the request queued) — carrying the decide->apply
+    # time-to-mitigation (t_decide/t_apply/ttm_s/steps_since_decide);
+    # run_report --policy renders and gates on it
+    "control",
     # eager-parity debug rail (parity/): one event per completed
     # --parity-check capture — both gate verdicts (bitwise replay vs the
     # recorded trajectory, tolerance-gated eager reference), the first

@@ -21,40 +21,58 @@ Spec grammar (one ``--policy`` flag per rule, repeatable)::
 
 ``ALERT`` is matched against the firing alert's spec (exact) or its
 metric name (so one policy rule can cover several thresholds on the same
-metric).  Actions:
+metric).  Every action declares its **application boundary**
+(:data:`ACTION_BOUNDARY`): ``immediate`` actions run inside the deciding
+process the moment the rule fires; ``chunk`` actions travel through the
+mid-epoch control channel (``resilience/control.py``) and apply at the
+trainer's next chunk boundary — the same poll that drains mid-epoch
+preemptions — falling back to the next epoch boundary only under
+``--control-boundary epoch``.  Actions:
 
 ==================  ====================================================
-``drain_host``      write the same ``<ckpt>/fleet/host-i.down`` marker an
-                    operator writes today (the fleet path is IDENTICAL:
-                    the FleetSupervisor consumes the marker, drains the
-                    attempt, and re-renders the world without the host).
-                    The host is resolved from the alert's source process
-                    through ``fleet/status.json``'s rank→host map.
-``rewarm_serve``    re-run ``warmup()`` on the affected bucket subset of
-                    EVERY ready replica of the routed serving fleet
-                    after a post-warmup recompile storm (in-process
-                    serving action; the serve session binds it via
-                    :func:`serve_actions`, whose per-replica report
-                    rides the ``completed`` policy event).
-``rollback``        the existing watchdog rollback path (verified
-                    restore + replay).  Supervisor-side this defers
-                    through the request channel below; the trainer
-                    consumes it at the next epoch boundary.
+``drain_host``      boundary **chunk**: write the same
+                    ``<ckpt>/fleet/host-i.down`` marker an operator
+                    writes today (the fleet path is IDENTICAL: the
+                    FleetSupervisor consumes the marker, drains the
+                    attempt, and re-renders the world without the host)
+                    plus a ``control-drain.req`` so the trainer
+                    drain-checkpoints cleanly at its next chunk instead
+                    of riding the SIGTERM grace window.  The host is
+                    resolved from the alert's source process through
+                    ``fleet/status.json``'s rank→host map.
+``rewarm_serve``    boundary **immediate**: re-run ``warmup()`` on the
+                    affected bucket subset of EVERY ready replica of the
+                    routed serving fleet after a post-warmup recompile
+                    storm (in-process serving action; the serve session
+                    binds it via :func:`serve_actions`, whose
+                    per-replica report rides the ``completed`` policy
+                    event).
+``rollback``        boundary **chunk**: the existing watchdog rollback
+                    path (verified restore + replay).  Supervisor-side
+                    this defers through the control channel; the trainer
+                    consumes it at the next chunk boundary and re-enters
+                    the epoch without blessing the state it is revoking.
 ``abort_with_evidence``
-                    orderly abort: the blackbox ring plus the alert and
-                    policy timelines are attached to ``crash_dump.json``,
-                    and a supervising restart loop stops instead of
+                    boundary **chunk**: orderly abort at the next chunk
+                    — the blackbox ring plus the alert and policy
+                    timelines are attached to ``crash_dump.json``, and a
+                    supervising restart loop stops instead of
                     relaunching a regressed run.
-``replan``          drain the running fleet attempt deliberately and
-                    re-run the auto-parallel planner at the next boundary
-                    against the freshest ledger (``parallel/planner.py``)
-                    — the HBM-ledger-breach remediation: the breach's own
-                    gauges are in the ledger the re-plan fits, so the new
-                    layout lands under the footprint gate.  Needs
+``replan``          boundary **chunk**: drain the running fleet attempt
+                    deliberately (a ``control-drain.req`` the trainer
+                    honors mid-epoch) and re-run the auto-parallel
+                    planner at the attempt boundary against the freshest
+                    ledger (``parallel/planner.py``) — the
+                    HBM-ledger-breach remediation: the breach's own
+                    gauges are in the ledger the re-plan fits, so the
+                    new layout lands under the footprint gate.  Needs
                     ``--parallel-plan auto`` under an elastic fleet with
                     a known ``--fleet-local-devices``; the replan drain
                     is budget-free supervisor work (the policy cooldown/
                     budget already rate-limit it).
+``scale_serve``     boundary **immediate**: one forced queueing-aware
+                    autoscaler sizing step (serving sessions with
+                    ``--serve-scale-target`` only).
 ==================  ====================================================
 
 Every decision — suppressed or acted — emits one registered ``policy``
@@ -77,10 +95,15 @@ must not be able to flap):
 Deferred actions (``rollback`` / ``abort_with_evidence`` decided
 supervisor-side but applied in-process) travel through a request file
 under ``<ckpt>/fleet/`` — the same marker-file idiom as host
-re-admission — and the applying process emits the matching ``completed``
-/ ``failed`` event, so ``run_report --policy`` can flag an action that
-was requested but never landed (the process died first) with a nonzero
-exit.
+re-admission.  Under the default ``--control-boundary chunk`` that file
+is a ``control-{action}.req`` the trainer consumes at its next CHUNK
+boundary (``resilience/control.py``); ``--control-boundary epoch``
+keeps the legacy ``policy-{action}.req`` epoch-boundary channel.
+Either way the applying process emits the matching ``completed`` /
+``failed`` policy event plus a ``control`` event carrying the
+decide→apply latency, so ``run_report --policy`` can both render
+time-to-mitigation and flag an action that was requested but never
+landed (the process died first) with a nonzero exit.
 """
 
 from __future__ import annotations
@@ -97,6 +120,20 @@ ACTIONS = (
     "drain_host", "rewarm_serve", "rollback", "abort_with_evidence",
     "replan", "scale_serve",
 )
+
+# Every registered action declares where it applies (lint-enforced by
+# tests/test_control.py): "immediate" runs inside the deciding process
+# the moment the rule fires; "chunk" travels through the control channel
+# (resilience/control.py) and applies at the trainer's next chunk
+# boundary (--control-boundary epoch degrades it to the epoch boundary).
+ACTION_BOUNDARY = {
+    "drain_host": "chunk",
+    "rewarm_serve": "immediate",
+    "rollback": "chunk",
+    "abort_with_evidence": "chunk",
+    "replan": "chunk",
+    "scale_serve": "immediate",
+}
 MODES = ("off", "dry-run", "act")
 DEFAULT_COOLDOWN_S = 60.0
 MAX_ACTIONS_DEFAULT = 4
@@ -107,8 +144,11 @@ MAX_ACTIONS_DEFAULT = 4
 BUDGET_WINDOW_S = 900.0
 
 # actions a supervisor-side decision defers to the training process via
-# the request channel (one shared file per action; the trainer polls at
-# epoch boundaries and process 0's read is broadcast under multi-host)
+# the LEGACY epoch-boundary request channel (one shared file per action;
+# process 0's read is broadcast under multi-host).  The default
+# --control-boundary chunk routes these through resilience/control.py's
+# chunk-boundary channel instead; this one remains the explicit
+# --control-boundary epoch path (and the wire format older roots used)
 REQUEST_ACTIONS = ("rollback", "abort_with_evidence")
 REQUEST_DIRNAME = "fleet"  # shared with the host marker files
 
@@ -576,7 +616,7 @@ def emit_completion(
 
 def supervisor_actions(
     ckpt_root, *, fleet_hosts: int = 0, request_stop=None,
-    request_replan=None,
+    request_replan=None, boundary: str = "epoch", attempt=None,
 ) -> dict:
     """The supervisor-side executor set.
 
@@ -584,16 +624,62 @@ def supervisor_actions(
     writes today — the fleet consumption path is byte-identical, so
     everything proven about manual drains (mid-attempt drain, world
     re-render, budget semantics) holds for automated ones.  ``rollback``
-    and ``abort_with_evidence`` defer through the request channel (the
-    state they act on lives in the training process); the abort
-    additionally asks the restart loop to stop, so a regressed run is
-    not relaunched over its own evidence.  ``rewarm_serve`` is absent on
-    purpose: serving runs in-process and binds its own — leaving it
-    genuinely UNBOUND here means a supervisor-side rewarm rule is
-    reported (state ``unbound``) without arming its cooldown or burning
-    the shared budget on decisions that could only fail.
+    and ``abort_with_evidence`` defer to the training process (the state
+    they act on lives over there); the abort additionally asks the
+    restart loop to stop, so a regressed run is not relaunched over its
+    own evidence.  ``rewarm_serve`` is absent on purpose: serving runs
+    in-process and binds its own — leaving it genuinely UNBOUND here
+    means a supervisor-side rewarm rule is reported (state ``unbound``)
+    without arming its cooldown or burning the shared budget on
+    decisions that could only fail.
+
+    ``boundary`` selects the deferral channel (``--control-boundary``):
+    ``"chunk"`` routes rollback/abort through the mid-epoch control
+    channel (``resilience/control.py``) and additionally queues a
+    ``control-drain.req`` for drain_host/replan so the trainer
+    drain-checkpoints at its next chunk; ``"epoch"`` keeps the legacy
+    ``policy-{action}.req`` files the trainer consumes at epoch
+    boundaries.  ``attempt`` is a zero-arg callable returning the
+    current attempt index — it scopes drain-class control requests so a
+    request orphaned across a restart is discarded as stale instead of
+    draining every later attempt.
     """
+    from ..resilience import control as control_mod
+
     root = Path(ckpt_root)
+    if boundary not in control_mod.BOUNDARIES:
+        raise PolicySpecError(
+            f"--control-boundary {boundary!r}: choose from "
+            f"{', '.join(control_mod.BOUNDARIES)}"
+        )
+    attempt = attempt or (lambda: 0)
+
+    def _defer(action: str, decision: dict) -> dict:
+        """Queue a trainer-applied action on the channel the boundary
+        selects; both channels share the unconsumed-file-wins contract,
+        so the coalescing semantics are identical."""
+        if boundary == "chunk":
+            queued = control_mod.write_control_request(
+                root, action, decision, attempt=attempt()
+            )
+        else:
+            queued = write_action_request(root, action, decision)
+        if queued is None:
+            # an unconsumed request is already queued: one boundary
+            # application satisfies both — this decision completes NOW
+            # instead of orphaning an id nobody will ever apply
+            return {"coalesced": True}
+        return {"deferred": True}
+
+    def _queue_drain(decision: dict, verb: str) -> bool:
+        """drain_host/replan under the chunk boundary: ask the trainer
+        for a clean drain-checkpoint at its next chunk (the SIGTERM
+        grace path still backstops a trainer that never reaches one)."""
+        if boundary != "chunk":
+            return False
+        return control_mod.write_control_request(
+            root, "drain", dict(decision, verb=verb), attempt=attempt()
+        ) is not None
 
     def _host_of(decision: dict) -> int:
         src = decision.get("alert_source")
@@ -619,6 +705,10 @@ def supervisor_actions(
                 "drain_host needs an elastic fleet (--fleet-hosts > 1)"
             )
         host = _host_of(decision)
+        # the control request goes FIRST: the fleet's marker poll
+        # SIGTERMs the attempt within one poll interval, and the trainer
+        # should find the clean-drain request before that grace race
+        controlled = _queue_drain(dict(decision, host=host), "drain_host")
         d = root / REQUEST_DIRNAME
         d.mkdir(parents=True, exist_ok=True)
         marker = d / f"host-{host}.down"
@@ -626,25 +716,18 @@ def supervisor_actions(
             json.dumps({"by": "policy", "rule": decision.get("rule"),
                         "id": decision.get("id")})
         )
-        return {"host": host, "marker": marker.name}
+        return {"host": host, "marker": marker.name, "control": controlled}
 
     def rollback(decision: dict) -> dict:
-        if write_action_request(root, "rollback", decision) is None:
-            # an unconsumed request is already queued: one boundary
-            # application satisfies both — this decision completes NOW
-            # instead of orphaning an id nobody will ever apply
-            return {"coalesced": True}
-        return {"deferred": True}
+        return _defer("rollback", decision)
 
     def abort_with_evidence(decision: dict) -> dict:
-        queued = write_action_request(root, "abort_with_evidence", decision)
+        result = _defer("abort_with_evidence", decision)
         if request_stop is not None:
             request_stop(
                 f"policy abort_with_evidence ({decision.get('rule')})"
             )
-        if queued is None:
-            return {"coalesced": True}
-        return {"deferred": True}
+        return result
 
     def replan(decision: dict) -> dict:
         # drain + re-plan at the next attempt boundary (FleetSupervisor
@@ -660,8 +743,9 @@ def supervisor_actions(
             f"policy rule {decision.get('rule')!r} "
             f"(alert {decision.get('trigger')!r})"
         )
+        controlled = _queue_drain(decision, "replan")
         request_replan(reason)
-        return {"reason": reason}
+        return {"reason": reason, "control": controlled}
 
     return {
         "drain_host": drain_host,

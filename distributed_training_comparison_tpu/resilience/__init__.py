@@ -12,6 +12,12 @@ makes failure a first-class, testable code path:
 - ``preempt``    — SIGTERM/injected-preemption handler: drain the async
                    checkpointer, write a final ``last.ckpt``, exit with a
                    distinct code the supervisor recognizes as transient;
+- ``control``    — the mid-epoch control plane: durable request files
+                   that land supervisor/policy decisions (rollback,
+                   abort, drain, replan) at the trainer's next CHUNK
+                   boundary through the same drain machinery as
+                   mid-epoch preemption, with per-decision
+                   time-to-mitigation ``control`` events;
 - ``ckpt_io``    — atomic tmp+fsync+rename writes, a sidecar integrity
                    manifest (payload checksum, step, mesh shape), and
                    verify-on-restore with previous-good rotation;
@@ -48,12 +54,20 @@ from .elastic import (
     topology,
     validate_reshard,
 )
+from .control import (
+    CONTROL_KIND,
+    ControlPoller,
+    MidEpochRollback,
+    pending_control,
+    write_control_request,
+)
 from .faults import (
     CHAOS_KIND,
     CHAOS_SCENARIOS,
     FaultEvent,
     FaultPlan,
     FaultSpecError,
+    SchedulerProbe,
     check_chaos_expectations,
 )
 from .fleet import FleetPlanError, FleetSupervisor, widest_legal_world
@@ -81,7 +95,13 @@ __all__ = [
     "widest_legal_world",
     "CHAOS_KIND",
     "CHAOS_SCENARIOS",
+    "CONTROL_KIND",
+    "ControlPoller",
+    "MidEpochRollback",
+    "SchedulerProbe",
     "check_chaos_expectations",
+    "pending_control",
+    "write_control_request",
     "FaultEvent",
     "FaultPlan",
     "FaultSpecError",

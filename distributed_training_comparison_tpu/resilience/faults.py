@@ -58,6 +58,7 @@ restart replays identical decisions for identical epochs.
 from __future__ import annotations
 
 import random
+import subprocess
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -321,6 +322,76 @@ def tear_file(path: str | Path) -> None:
     path.write_bytes(data[: max(1, len(data) // 2)])
 
 
+# ------------------------------------------------- scheduler re-admission
+
+PROBE_TIMEOUT_S = 5.0
+
+
+class SchedulerProbe:
+    """The scheduler's re-admission interface, automated.
+
+    A drained host's ``host-i.up`` marker used to be written by hand (or
+    by a chaos driver standing in for the scheduler).  ``--fleet-probe``
+    binds that marker to a real schedulability signal the supervisor
+    polls for every LOST host on its marker cadence:
+
+    - ``file:PATH``  — the slot is schedulable when PATH exists
+      (``{host}`` in PATH is substituted with the host index — the
+      shape a k8s node-ready touch-file or GCE guest-attribute mirror
+      takes on shared storage);
+    - ``exec:CMD``   — run CMD through the shell; exit 0 means
+      schedulable (``{host}`` substituted, else the index is appended
+      as an argv tail).  A nonzero exit is "not yet", not a failure.
+
+    When the probe itself breaks — malformed spec, command not found,
+    timeout, unreadable path — it degrades PERMANENTLY to the manual
+    marker path with exactly one warning: a flapping probe must not spam
+    the supervisor log or, worse, flap the world size.  Operators can
+    still write ``host-i.up`` by hand; the probe only automates it.
+    """
+
+    def __init__(self, spec: str, *, log=None) -> None:
+        self.spec = spec
+        self._log = log or (lambda msg: None)
+        self._failed = False
+        kind, _, arg = spec.partition(":")
+        self.kind, self.arg = kind, arg
+        if kind not in ("exec", "file") or not arg:
+            self._degrade(f"malformed --fleet-probe spec {spec!r} "
+                          "(want exec:CMD or file:PATH)")
+
+    def _degrade(self, why: str) -> None:
+        if not self._failed:
+            self._failed = True
+            self._log(f"[fleet] probe failed ({why}); degrading to the "
+                      f"manual host-i.up marker path")
+
+    def check(self, host: int) -> bool:
+        """True when the scheduler says host ``host``'s slot is
+        schedulable again.  Never raises; infrastructure failures
+        degrade the probe (once) and read as "not schedulable"."""
+        if self._failed:
+            return False
+        if self.kind == "file":
+            try:
+                return Path(self.arg.replace("{host}", str(host))).exists()
+            except OSError as e:
+                self._degrade(f"file probe: {e}")
+                return False
+        cmd = self.arg
+        cmd = (cmd.replace("{host}", str(host)) if "{host}" in cmd
+               else f"{cmd} {host}")
+        try:
+            res = subprocess.run(
+                cmd, shell=True, timeout=PROBE_TIMEOUT_S,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            self._degrade(f"exec probe: {e}")
+            return False
+        return res.returncode == 0
+
+
 # ------------------------------------------------------- chaos matrix
 
 CHAOS_KIND = "chaos"
@@ -346,12 +417,14 @@ _SENTINEL_ALERT = "compile/recompiles_after_warmup:n>0:for=1"
 _REWARM_POLICY = f"{_SENTINEL_ALERT} -> rewarm_serve:cooldown=5"
 
 # Named scenarios composing preempt x straggler-stall x corrupt-shard
-# (nan_grad) x host-flap, each run end-to-end under the fleet supervisor
-# with the policy engine active (bench.py --chaos -> CHAOS.json).  Every
-# scenario recovers via policy/supervisor actions alone: the only marker
-# file a driver ever writes is ``host-1.up`` — the SCHEDULER's
-# re-admission interface (ROADMAP residue: a GCE/k8s probe would write
-# it), never an operator's ``host-i.down``.
+# (nan_grad) x host-flap x mid-epoch control, each run end-to-end under
+# the fleet supervisor with the policy engine active (bench.py --chaos
+# -> CHAOS.json).  Every scenario recovers via policy/supervisor actions
+# alone — no scenario writes an operator marker file.  Re-admission of a
+# killed host goes through the SCHEDULER's interface: either the legacy
+# driver writing ``host-1.up`` directly (``kill_and_readmit_host1``) or,
+# in ``probe_readmission``, a :class:`SchedulerProbe` ready-file the
+# driver creates and ``--fleet-probe`` turns into the marker.
 #
 # Field contract (consumed by ``bench.py --chaos`` and linted by tests):
 #   fault_plan   --fault-plan spec for the training child (or None)
@@ -508,6 +581,62 @@ CHAOS_SCENARIOS: dict[str, dict] = {
             "restarts": 0, "crash_dump_evidence": True,
         },
         "require_kinds": ("policy", "abort"),
+    },
+    "control_rollback": {
+        "desc": "sustained loss breach (spike detector blinded) -> loss "
+                "alert -> policy rollback lands on the mid-epoch CONTROL "
+                "channel -> the trainer applies it at a CHUNK boundary "
+                "inside the epoch and replays clean",
+        # the policy_rollback recipe with LONGER epochs (512 examples =
+        # 16 steps, chunk 2 -> 8 poll boundaries per epoch): the
+        # control-rollback.req lands mid-epoch with a whole epoch of
+        # chunk boundaries to catch it, and the post-spike stall is the
+        # same insurance window the legacy scenario uses.  The applied
+        # `control` event must say boundary=chunk — time-to-mitigation
+        # bounded by ONE CHUNK, not one epoch (the tentpole's claim).
+        "fault_plan": "loss_spike@epoch=5:scale=64:steps=3;"
+                      "stall@epoch=6:secs=4",
+        "alerts": (_SPIKE_ALERT,),
+        "policies": (_SPIKE_POLICY,),
+        "policy_mode": "act",
+        "driver": None,
+        "env": {},
+        "extra_args": (
+            "--health-spike-mads", "1e9", "--save-last-every", "5",
+            "--limit-examples", "512", "--epoch", "8",
+        ),
+        "expect": {
+            "final_rc": 0, "policy_completed__min": 1,
+            "rollbacks__min": 1, "alerts_fired__min": 1,
+            "controls_applied__min": 1, "control_mid_epoch__min": 1,
+            "policy_dry_run": 0,
+        },
+        "require_kinds": ("policy", "rollback", "control"),
+    },
+    "probe_readmission": {
+        "desc": "host 1 SIGKILLed (spot reclaim) -> shrink -> the "
+                "--fleet-probe scheduler probe sees the slot schedulable "
+                "(ready file) and writes host-1.up ITSELF -> deliberate "
+                "re-expand, zero operator/driver marker files",
+        # the host_flap scenario with the residue closed: the driver
+        # never touches <ckpt>/fleet/ — it only creates the probe's
+        # ready file (a k8s node-ready / GCE guest-attribute stand-in),
+        # and the SchedulerProbe turns that into the up marker on the
+        # supervisor's own poll cadence
+        "fault_plan": "stall@epoch=7:secs=6",  # same insurance window
+        # as host_flap: the re-admission must land mid-run on a fast box
+        "alerts": (_STRAGGLER_ALERT,),
+        "policies": (_STRAGGLER_POLICY,),
+        "policy_mode": "act",
+        "driver": "probe_readmit_host1",
+        "env": {},
+        # {root} is substituted by bench.py with the scenario's ckpt
+        # root; {host} survives for the probe's own substitution
+        "extra_args": ("--fleet-probe", "file:{root}/probe-ready-{host}"),
+        "expect": {
+            "final_rc": 0, "resizes__min": 2, "policy_completed": 0,
+        },
+        "require_kinds": ("resize",),
     },
     "serve_flash_rewarm": {
         "desc": "flash crowd lands on an unwarmed serve bucket -> "

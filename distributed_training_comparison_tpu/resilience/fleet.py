@@ -53,6 +53,7 @@ from pathlib import Path
 from typing import Sequence
 
 from .ckpt_io import atomic_write_bytes
+from .faults import SchedulerProbe
 from .supervisor import PlanRefused, Supervisor, strip_flags
 
 FLEET_DIR = "fleet"
@@ -67,7 +68,7 @@ _RENDERED_FLAGS = ("--world-size", "--rank", "--dist-url")
 # parent-loop-only flags that must never leak into a child
 _PARENT_FLAGS = (
     "--fleet-hosts", "--fleet-min-hosts", "--fleet-local-devices",
-    "--fleet-grace-secs", "--fleet-poll-secs",
+    "--fleet-grace-secs", "--fleet-poll-secs", "--fleet-probe",
 )
 # layout flags the supervisor's auto-parallel plan re-renders per attempt
 # (value-taking vs bare, because strip_flags assumes `--flag VALUE` pairs)
@@ -203,6 +204,7 @@ class FleetSupervisor(Supervisor):
         min_hosts: int = 1,
         grace_s: float = 15.0,
         poll_s: float = 0.5,
+        probe: str = "",
         spawn=None,
         coordinator_host: str = "127.0.0.1",
         plan_hparams=None,
@@ -221,6 +223,10 @@ class FleetSupervisor(Supervisor):
         self.min_hosts = max(1, int(min_hosts))
         self.grace_s = max(0.0, float(grace_s))
         self.poll_s = max(0.05, float(poll_s))
+        # --fleet-probe: the scheduler's re-admission signal, polled for
+        # every LOST host on the marker cadence; a schedulable slot is
+        # surfaced as the same host-i.up marker an operator would write
+        self.probe = SchedulerProbe(probe, log=self._log) if probe else None
         self._spawn = spawn or (
             lambda c, e: subprocess.Popen(list(c), env=e)
         )
@@ -290,6 +296,17 @@ class FleetSupervisor(Supervisor):
         (hosts newly lost, hosts newly returned) by THIS poll."""
         lost_now: list[int] = []
         returned_now: list[int] = []
+        if self.probe is not None:
+            # ask the scheduler about every lost slot; a schedulable
+            # answer becomes the same up marker an operator would write,
+            # consumed by the loop below in this very poll
+            for host in self.lost_hosts():
+                if self.probe.check(host):
+                    up = self._marker(host, "up")
+                    if not up.exists():
+                        up.write_text(json.dumps(
+                            {"by": "probe", "spec": self.probe.spec}
+                        ))
         for host in range(self.hosts):
             up = self._marker(host, "up")
             down = self._marker(host, "down")
@@ -774,4 +791,5 @@ def fleet_env_knobs(hparams) -> dict:
         "min_hosts": int(getattr(hparams, "fleet_min_hosts", 1) or 1),
         "grace_s": float(getattr(hparams, "fleet_grace_secs", 15.0)),
         "poll_s": float(getattr(hparams, "fleet_poll_secs", 1.0)) / 2.0,
+        "probe": str(getattr(hparams, "fleet_probe", "") or ""),
     }

@@ -146,6 +146,10 @@ class Supervisor:
         attempt = 0
         prev_marker = self._progress() if self._progress is not None else None
         while True:
+            # the live attempt index, readable by action executors that
+            # must stamp decisions with the attempt that made them (the
+            # fleet's _plan_attempt re-sets it; this covers the base loop)
+            self._attempt = attempt
             try:
                 self._plan_attempt(attempt)
             except PlanRefused as e:
@@ -463,6 +467,7 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
     # writes; rollback/abort defer through the request channel to the
     # training process; abort additionally stops the restart loop.
     from ..ops import policy as policy_mod
+    from . import control as control_mod
 
     policy_engine = policy_mod.engine_from_hparams(
         hparams, bus=bus, log=sup._log
@@ -480,6 +485,15 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
                     if getattr(sup, "plan_hparams", None) is not None
                     else None
                 ),
+                # --control-boundary chunk (default) routes deferred
+                # actions through the mid-epoch control channel; "epoch"
+                # keeps the legacy epoch-boundary request files
+                boundary=getattr(hparams, "control_boundary", None)
+                or control_mod.DEFAULT_BOUNDARY,
+                # drain-class control requests are scoped to the attempt
+                # that decided them, so one orphaned across a restart is
+                # discarded stale instead of draining every later attempt
+                attempt=lambda: int(getattr(sup, "_attempt", 0)),
             )
         )
 
@@ -517,6 +531,28 @@ def run_supervised(hparams, argv: Sequence[str] | None = None) -> dict:
                         error="run ended before the request was applied",
                     )
                 )
+        # same sweep for the chunk-boundary control channel: every
+        # leftover request is reported 'expired' on the control stream
+        # (so the decide→apply trail never just stops), and the
+        # trainer-applied verbs additionally get the 'failed' terminal
+        # their pending policy id needs
+        for req in control_mod.pending_control(hparams.ckpt_path):
+            bus.emit(
+                control_mod.CONTROL_KIND,
+                **control_mod.control_event_payload(
+                    req, state="expired", boundary="epoch", step=0,
+                ),
+            )
+            if req.get("id") is not None and req.get("action") in (
+                "rollback", "abort_with_evidence",
+            ):
+                policy_engine.observe_event(
+                    policy_mod.emit_completion(
+                        bus, req, ok=False,
+                        error="run ended before the request was applied",
+                    )
+                )
+        control_mod.clear_control_requests(hparams.ckpt_path)
         # the autopilot's ledger rides the supervisor summary into
         # GOODPUT.json: decisions by state, rules, anything still pending
         summary["policy"] = policy_engine.summary()

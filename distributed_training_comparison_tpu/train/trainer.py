@@ -32,8 +32,10 @@ TPU-native structure of ``fit``:
 
 from __future__ import annotations
 
+import bisect
 import os
 import time
+from collections import deque
 from contextlib import nullcontext
 from pathlib import Path
 
@@ -70,6 +72,7 @@ from ..parallel.sharding import (
 from ..resilience import (
     FaultPlan,
     GoodputMeter,
+    MidEpochRollback,
     Preempted,
     PreemptionHandler,
     read_and_hash,
@@ -1074,9 +1077,25 @@ class Trainer:
         # (<ckpt>/fleet/policy-*.req) there, because the supervisor is the
         # one evaluating the alerts.  drain_host/rewarm_serve have no
         # trainer-side executor (the fleet and the serve session own them).
+        from ..resilience import control as control_mod
+
         self.policy_engine = None
         self._policy_poller = None
+        self._control_poller = None
         self._policy_requests: list[dict] = []
+        # mid-epoch control plane (resilience/control.py): where policy
+        # actions apply.  "chunk" (default) is the tentpole path — the
+        # barrier below the preempt poll consumes decisions at every
+        # chunk boundary; "epoch" is the legacy baseline.
+        self._control_boundary = getattr(
+            hparams, "control_boundary", control_mod.DEFAULT_BOUNDARY
+        )
+        self._attempt_index = control_mod.current_attempt()
+        self._drain_requested = False
+        self._drain_reqs: list[dict] = []
+        # (t_wall, global_step) marks, one per chunk boundary: dating a
+        # supervisor decision on the step axis for steps_since_decide
+        self._ttm_marks: deque = deque(maxlen=4096)
         if getattr(hparams, "policy", None):
             from ..ops import policy as policy_mod
 
@@ -1102,12 +1121,25 @@ class Trainer:
                 self._policy_poller = policy_mod.PolicyRequestPoller(
                     hparams.ckpt_path
                 )
+                # the chunk-boundary control channel rides beside the
+                # legacy epoch-boundary one: the supervisor writes
+                # whichever --control-boundary selects, and the trainer
+                # keeps both polls live (one stat per action each) so a
+                # mixed-version root still drains
+                self._control_poller = control_mod.ControlPoller(
+                    hparams.ckpt_path
+                )
 
     def _policy_defer(self, decision: dict) -> dict:
         """In-process executor for rollback/abort: queue the decision for
-        the next epoch boundary (the rollback path runs collectives every
-        process must enter together; acting mid-tap would not be safe)."""
-        self._policy_requests.append(dict(decision))
+        the next control boundary (the rollback path runs collectives
+        every process must enter together; acting mid-tap would not be
+        safe).  Stamped with the decide-time wall clock so the applying
+        boundary's ``control`` event can carry the measured
+        time-to-mitigation."""
+        self._policy_requests.append(
+            dict(decision, t_decide=time.time())
+        )
         return {"deferred": True}
 
     def _obs_tick(self, *, epoch: int, step: int) -> None:
@@ -1121,6 +1153,10 @@ class Trainer:
         THIS thread would double the window rate and stop exactly when
         the hang it watches for begins).  Cost when nothing is due: two
         clock reads and a lock."""
+        # date this boundary on the step axis BEFORE the flush: a policy
+        # decision the flush triggers (in-process tap) then lands after
+        # its boundary's mark, so steps_since_decide starts at 0 here
+        self._ttm_marks.append((time.time(), step))
         self.heartbeat.beat(
             epoch=epoch, step=step, flush_seq=self.metrics.flushes
         )
@@ -1343,11 +1379,32 @@ class Trainer:
                 self.tracer.annotate = True
             self.bus.emit("epoch_start", epoch=epoch)
             t0 = time.perf_counter()
-            with self.tracer.span("epoch", epoch=epoch):
-                if self.data_mode == "device":
-                    losses, top1 = self._train_epoch_device(epoch)
-                else:
-                    losses, top1 = self._train_epoch_host(epoch)
+            try:
+                with self.tracer.span("epoch", epoch=epoch):
+                    if self.data_mode == "device":
+                        losses, top1 = self._train_epoch_device(epoch)
+                    else:
+                        losses, top1 = self._train_epoch_host(epoch)
+            except MidEpochRollback as ctl:
+                # a chunk-boundary policy rollback unwound the epoch (the
+                # barrier already booked its step time): apply the same
+                # verified restore as the epoch-boundary path, then
+                # re-enter the loop at the restored epoch.  This partial
+                # epoch never validates, checkpoints, or blesses a best —
+                # exactly the property the boundary move must preserve.
+                if profiling:
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+                    self.tracer.annotate = False
+                next_epoch = self._apply_control_rollback(
+                    epoch, time.perf_counter() - t0, ctl
+                )
+                if next_epoch is not None:
+                    epoch = next_epoch
+                # an unappliable rollback re-enters the SAME epoch from
+                # its start: the state was never touched and the per-step
+                # key fold replays it deterministically
+                continue
             epoch_time = time.perf_counter() - t0
             self.goodput.add("step", epoch_time)
             if profiling:
@@ -2020,27 +2077,47 @@ class Trainer:
         policy rollback, or None.  ``abort_with_evidence`` raises
         :class:`~..ops.policy.PolicyAbort` after dumping the evidence.
         """
-        if self.policy_engine is None and self._policy_poller is None:
+        if (
+            self.policy_engine is None
+            and self._policy_poller is None
+            and self._control_poller is None
+        ):
             return None
         reqs, self._policy_requests = self._policy_requests, []
-        if self._policy_poller is not None and self.is_main:
+        if self.is_main:
             # consume (read + unlink) HERE, where application immediately
             # follows in the same call — a pickup earlier in the epoch
             # would widen the window in which a crash loses a consumed-
             # but-unapplied request to an unrecoverable pending state
-            reqs.extend(self._policy_poller.poll())
+            if self._policy_poller is not None:
+                reqs.extend(self._policy_poller.poll())
+            if self._control_poller is not None:
+                # decisions that landed during the epoch's FINAL chunk
+                # (the mid-epoch barrier stops one boundary early) apply
+                # here instead of waiting out another epoch
+                reqs.extend(self._control_poller.poll())
+        reqs = self._discard_stale_controls(
+            reqs, epoch=epoch, step=(epoch + 1) * self.steps_per_epoch,
+            boundary="epoch",
+        )
         abort_reqs = [
             r for r in reqs if r.get("action") == "abort_with_evidence"
         ]
         roll_reqs = [r for r in reqs if r.get("action") == "rollback"]
+        drain_reqs = [r for r in reqs if r.get("action") == "drain"]
         abort_req = abort_reqs[0] if abort_reqs else None
         roll_req = roll_reqs[0] if roll_reqs else None
+        drain_req = drain_reqs[0] if drain_reqs else None
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
             flags = np.any(
                 multihost_utils.process_allgather(
-                    np.asarray([abort_req is not None, roll_req is not None])
+                    np.asarray([
+                        abort_req is not None,
+                        roll_req is not None,
+                        drain_req is not None,
+                    ])
                 ),
                 axis=0,
             )
@@ -2051,8 +2128,23 @@ class Trainer:
                 abort_req = {"action": "abort_with_evidence"}
             if flags[1] and roll_req is None:
                 roll_req = {"action": "rollback"}
+            if flags[2] and drain_req is None:
+                drain_req = {"action": "drain"}
         from ..ops import policy as policy_mod
 
+        if drain_req is not None and abort_req is None:
+            # a drain_host/replan control request reaching the epoch
+            # boundary: arm the drain flag — this epoch checkpoints
+            # normally, then the boundary preempt poll below the save
+            # drains through the proven _preempt_exit path
+            for r in drain_reqs:
+                self._emit_control(
+                    r, state="applied", epoch=epoch,
+                    step=(epoch + 1) * self.steps_per_epoch,
+                    boundary="epoch",
+                )
+            self._drain_requested = True
+            self._drain_reqs.extend(drain_reqs)
         if abort_req is not None:
             # the abort supersedes everything else queued this boundary:
             # close every OTHER id first (as 'coalesced' — the superseded
@@ -2064,7 +2156,10 @@ class Trainer:
                         self.bus, r, state="coalesced",
                         coalesced_into=abort_req.get("id"),
                     )
-            self._policy_abort_exit(epoch, abort_req)  # raises PolicyAbort
+            self._policy_abort_exit(
+                epoch, abort_req,
+                step=(epoch + 1) * self.steps_per_epoch, boundary="epoch",
+            )  # raises PolicyAbort
         if roll_req is None:
             return None
 
@@ -2097,13 +2192,21 @@ class Trainer:
         # ONE rollback satisfies every request queued this boundary; each
         # id gets its outcome so none reads as pending
         for r in roll_reqs:
+            self._emit_control(
+                r, state="applied", epoch=epoch,
+                step=(epoch + 1) * self.steps_per_epoch, boundary="epoch",
+                from_epoch=epoch, to_epoch=next_epoch,
+            )
             if r.get("id") is not None:
                 policy_mod.emit_completion(
                     self.bus, r, from_epoch=epoch, to_epoch=next_epoch
                 )
         return next_epoch
 
-    def _policy_abort_exit(self, epoch: int, req: dict) -> None:
+    def _policy_abort_exit(
+        self, epoch: int, req: dict, *, step: int | None = None,
+        boundary: str = "epoch",
+    ) -> None:
         """``abort_with_evidence``: drain the writer (the last good
         checkpoint stays durable), attach the alert + policy timelines to
         ``crash_dump.json`` next to the flight-recorder ring, and raise.
@@ -2121,6 +2224,11 @@ class Trainer:
                 self.ckpt_writer.wait()
             except Exception as e:
                 self.logger.error(f"checkpoint writer error: {e}")
+        if step is None:
+            step = (epoch + 1) * self.steps_per_epoch
+        self._emit_control(
+            req, state="applied", epoch=epoch, step=step, boundary=boundary,
+        )
         if req.get("id") is not None:
             policy_mod.emit_completion(self.bus, req, epoch=epoch)
         self.bus.emit("abort", epoch=epoch, reason=msg)
@@ -2160,6 +2268,263 @@ class Trainer:
         )
         raise policy_mod.PolicyAbort(msg)
 
+    # --------------------------------------------- mid-epoch control plane
+
+    def _gstep_at(self, t_wall: float) -> int | None:
+        """The global step the run was at when ``t_wall`` happened —
+        the latest chunk-boundary mark not after it (None before the
+        first mark), dating a supervisor decision on the step axis."""
+        marks = self._ttm_marks
+        if not marks:
+            return None
+        idx = bisect.bisect_right([t for t, _ in marks], t_wall) - 1
+        if idx < 0:
+            return 0
+        return marks[idx][1]
+
+    def _emit_control(
+        self, req: dict, *, state: str, epoch: int, step: int,
+        boundary: str, **extra,
+    ) -> None:
+        """One registered ``control`` event per request reaching a
+        boundary: identity + decide→apply latency in seconds and steps."""
+        from ..resilience import control as control_mod
+
+        step_at_decide = None
+        t_decide = req.get("t_decide")
+        if isinstance(t_decide, (int, float)):
+            step_at_decide = self._gstep_at(float(t_decide))
+        self.bus.emit(
+            control_mod.CONTROL_KIND, epoch=epoch, step=step,
+            **control_mod.control_event_payload(
+                req, state=state, boundary=boundary, step=step,
+                step_at_decide=step_at_decide, **extra,
+            ),
+        )
+
+    def _discard_stale_controls(
+        self, reqs: list[dict], *, epoch: int, step: int, boundary: str,
+    ) -> list[dict]:
+        """Drop attempt-scoped control requests decided for an earlier
+        attempt (the boundary they asked for already happened — the
+        supervisor restarted before the trainer consumed the file) with
+        a ``superseded`` control event each, so nothing dangles and
+        nothing double-applies: the one-shot-across-restarts contract
+        mid-epoch preemption already keeps (``FaultPlan.preempt_step_due``
+        fires once per window)."""
+        from ..resilience import control as control_mod
+
+        fresh = []
+        for r in reqs:
+            if control_mod.is_stale(r, self._attempt_index):
+                self.logger.warning(
+                    f"control: stale {r.get('action')} request from "
+                    f"attempt {r.get('attempt')} discarded (now attempt "
+                    f"{self._attempt_index}: its boundary already ran)"
+                )
+                self._emit_control(
+                    r, state="superseded", epoch=epoch, step=step,
+                    boundary=boundary,
+                )
+            else:
+                fresh.append(r)
+        return fresh
+
+    def _rollback_target_exists(self) -> bool:
+        """Is there anything a rollback could restore — a verified save
+        in this run's version dir, or the read-only resume source?  The
+        mid-epoch barrier asks BEFORE unwinding the chunk loop; process
+        0 owns the version dir, so the answer is broadcast (the
+        ``_rollback`` found-target idiom, one boundary earlier)."""
+        hit = False
+        if self.is_main:
+            if self.ckpt_writer is not None:
+                # an in-flight async save IS a target: drain it before
+                # validating, or the mid-rewrite last/prev-last pair
+                # reads as "no checkpoint" and a viable rollback is
+                # needlessly deferred to the epoch boundary
+                try:
+                    self.ckpt_writer.wait()
+                except Exception:
+                    pass  # a failed save falls through to prev-/resume
+            try:
+                hit = (
+                    self.version_dir is not None
+                    and ckpt.valid_resume_bytes_in(self.version_dir)
+                    is not None
+                )
+            except Exception:
+                hit = False
+            if not hit and self._rollback_source:
+                hit = Path(self._rollback_source).exists()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            hit = bool(
+                multihost_utils.broadcast_one_to_all(np.asarray(hit))
+            )
+        return hit
+
+    def _control_barrier(self, epoch: int, step: int) -> list[dict] | None:
+        """The chunk-boundary control poll (the tentpole seam): consume
+        any queued policy decisions and apply them INSIDE the epoch.
+
+        Sources and symmetry are the ``_apply_policy_requests`` idiom —
+        the in-process engine's queue plus process 0's read of the
+        control files, allgather-OR'd under multi-host so every process
+        enters the drain/rollback collectives together.  Application per
+        action: ``abort_with_evidence`` dumps evidence and raises here;
+        a ``drain`` request arms ``_drain_requested`` so the preempt
+        poll one line below this call drains through the proven
+        mid-epoch checkpoint path; ``rollback`` cannot run under the
+        live chunk iterators, so its requests are returned for the call
+        site to unwind to ``fit()`` (``MidEpochRollback``).  Returns
+        None when nothing rollback-shaped is due."""
+        if self._control_boundary != "chunk":
+            return None
+        if self.policy_engine is None and self._control_poller is None:
+            return None
+        reqs: list[dict] = []
+        if self._policy_requests:
+            # requests parked for the EPOCH boundary (a rollback decided
+            # before the first verified save — see below) stay queued for
+            # _apply_policy_requests; everything else is consumed here
+            pend, self._policy_requests = self._policy_requests, []
+            self._policy_requests = [r for r in pend if r.get("_epoch_only")]
+            reqs = [r for r in pend if not r.get("_epoch_only")]
+        if self._control_poller is not None and self.is_main:
+            reqs.extend(self._control_poller.poll())
+        gstep = epoch * self.steps_per_epoch + step
+        reqs = self._discard_stale_controls(
+            reqs, epoch=epoch, step=gstep, boundary="chunk"
+        )
+        abort_reqs = [
+            r for r in reqs if r.get("action") == "abort_with_evidence"
+        ]
+        roll_reqs = [r for r in reqs if r.get("action") == "rollback"]
+        drain_reqs = [r for r in reqs if r.get("action") == "drain"]
+        if jax.process_count() > 1 and (
+            self.policy_engine is not None or self._control_poller is not None
+        ):
+            from jax.experimental import multihost_utils
+
+            flags = np.any(
+                multihost_utils.process_allgather(
+                    np.asarray([
+                        bool(abort_reqs), bool(roll_reqs), bool(drain_reqs),
+                    ])
+                ),
+                axis=0,
+            )
+            # a peer holds the request this process didn't see; act
+            # together, leave completion emission to the id holder
+            if flags[0] and not abort_reqs:
+                abort_reqs = [{"action": "abort_with_evidence"}]
+            if flags[1] and not roll_reqs:
+                roll_reqs = [{"action": "rollback"}]
+            if flags[2] and not drain_reqs:
+                drain_reqs = [{"action": "drain"}]
+        if not (abort_reqs or roll_reqs or drain_reqs):
+            return None
+        from ..ops import policy as policy_mod
+
+        if drain_reqs:
+            # drain_host/replan: arm the drain — the preempt poll at this
+            # same boundary takes the proven mid-epoch drain-checkpoint
+            # exit, and the supervisor re-renders the world / re-plans at
+            # the attempt boundary this exit creates
+            for r in drain_reqs:
+                self._emit_control(
+                    r, state="applied", epoch=epoch, step=gstep,
+                    boundary="chunk",
+                )
+            self._drain_requested = True
+            self._drain_reqs.extend(drain_reqs)
+        if abort_reqs:
+            # the abort supersedes everything else queued this boundary
+            for r in abort_reqs[1:] + roll_reqs:
+                if r.get("id") is not None:
+                    policy_mod.emit_completion(
+                        self.bus, r, state="coalesced",
+                        coalesced_into=abort_reqs[0].get("id"),
+                    )
+            self._policy_abort_exit(
+                epoch, abort_reqs[0], step=gstep, boundary="chunk",
+            )  # raises PolicyAbort
+        if not roll_reqs:
+            return None
+        # rollback viability is checked HERE, before unwinding the epoch:
+        # a request that cannot apply must not abandon the chunk loop
+        why = None
+        if self.watchdog is None:
+            why = "the health watchdog is disabled (--no-health)"
+        elif self.watchdog.exhausted():
+            why = (
+                f"rollback budget "
+                f"({self.watchdog.cfg.max_rollbacks}) already exhausted"
+            )
+        if why is not None:
+            self.logger.error(f"policy rollback not applied: {why}")
+            for r in roll_reqs:
+                if r.get("id") is not None:
+                    policy_mod.emit_completion(self.bus, r, ok=False, error=why)
+            return None
+        if not self._rollback_target_exists():
+            # decided before this run's first verified save: the epoch
+            # boundary right after the save is the EARLIEST boundary that
+            # can apply it.  Park the request there (the legacy path)
+            # instead of unwinding a chunk loop with nothing to restore
+            # — or failing a decision that becomes viable one save later.
+            self.logger.warning(
+                "control: rollback requested before the first verified "
+                "checkpoint; deferring to the epoch boundary"
+            )
+            self._policy_requests.extend(
+                dict(r, _epoch_only=True) for r in roll_reqs
+            )
+            return None
+        return roll_reqs
+
+    def _apply_control_rollback(
+        self, epoch: int, epoch_time: float, ctl,
+    ) -> int | None:
+        """Apply a chunk-boundary rollback after ``MidEpochRollback``
+        unwound the epoch: the same verified restore + replay as the
+        epoch-boundary path (identical checkpoint source, identical
+        restored leaves — pinned by tests/test_control.py), entered from
+        ``fit()`` where no chunk iterator is live.  Returns the epoch to
+        re-enter, or None when no verified checkpoint exists (the epoch
+        is then re-entered from its start: the state was never touched,
+        and the per-step key fold replays it deterministically)."""
+        from ..ops import policy as policy_mod
+
+        roll_reqs = ctl.requests
+        gstep = epoch * self.steps_per_epoch + ctl.steps_done
+        reason = f"policy action ({roll_reqs[0].get('rule') or 'rollback'})"
+        self.logger.warning(
+            f"policy: rollback requested mid-epoch {epoch} "
+            f"(step {ctl.steps_done}/{self.steps_per_epoch}): {reason}"
+        )
+        with self.tracer.span("rollback", epoch=epoch):
+            next_epoch = self._rollback(epoch, epoch_time, reason)
+        if next_epoch is None:
+            why = "no verified rollback checkpoint available"
+            self.logger.error(f"policy rollback not applied: {why}")
+            for r in roll_reqs:
+                if r.get("id") is not None:
+                    policy_mod.emit_completion(self.bus, r, ok=False, error=why)
+            return None
+        for r in roll_reqs:
+            self._emit_control(
+                r, state="applied", epoch=epoch, step=gstep,
+                boundary="chunk", from_epoch=epoch, to_epoch=next_epoch,
+            )
+            if r.get("id") is not None:
+                policy_mod.emit_completion(
+                    self.bus, r, from_epoch=epoch, to_epoch=next_epoch
+                )
+        return next_epoch
+
     # ------------------------------------------------------------- resilience
 
     def _preempt_due(
@@ -2180,11 +2545,19 @@ class Trainer:
         present): non-resilient multi-host training keeps its schedule
         unchanged.
         """
-        if self.preempt_handler is None and self.fault_plan is None:
+        if (
+            self.preempt_handler is None
+            and self.fault_plan is None
+            and not self._drain_requested
+        ):
             return False
+        # a control-plane drain (drain_host/replan applied at a chunk or
+        # epoch boundary) rides this poll: _control_barrier armed the
+        # flag symmetrically (its own allgather), so every process exits
+        # through the same drain-checkpoint path together
         due = bool(
             self.preempt_handler is not None and self.preempt_handler.triggered
-        )
+        ) or self._drain_requested
         if self.fault_plan is not None:
             if step is None:
                 # boundary check: step=S events normally fire mid-epoch
@@ -2637,14 +3010,25 @@ class Trainer:
             self._obs_tick(epoch=epoch, step=epoch * steps + done)
             if bar is not None:
                 bar.update(take)
-            if done < steps and self._preempt_due(
-                epoch, step=done, start_offset=offset
-            ):
-                if bar is not None:
-                    bar.close()
-                # fit() never sees this partial epoch; book its step time
-                self.goodput.add("step", time.perf_counter() - t_epoch)
-                self._preempt_exit_mid_epoch(epoch, done)
+            if done < steps:
+                # control barrier first: a queued drain arms the preempt
+                # poll below; a rollback unwinds to fit(); an abort
+                # raises from inside the barrier
+                roll_reqs = self._control_barrier(epoch, step=done)
+                if roll_reqs is not None:
+                    if bar is not None:
+                        bar.close()
+                    # fit() re-enters after the rollback; book step time
+                    self.goodput.add("step", time.perf_counter() - t_epoch)
+                    raise MidEpochRollback(
+                        epoch=epoch, steps_done=done, requests=roll_reqs
+                    )
+                if self._preempt_due(epoch, step=done, start_offset=offset):
+                    if bar is not None:
+                        bar.close()
+                    # fit() never sees this partial epoch; book its step time
+                    self.goodput.add("step", time.perf_counter() - t_epoch)
+                    self._preempt_exit_mid_epoch(epoch, done)
         if bar is not None:
             bar.close()
         return self._collect_epoch_metrics(chunk_metrics)
@@ -2815,14 +3199,26 @@ class Trainer:
                 self._obs_tick(epoch=epoch, step=epoch * steps + done)
                 if bar is not None:
                     bar.update(take)
-                if done < steps and self._preempt_due(
-                    epoch, step=done, start_offset=offset
-                ):
-                    if bar is not None:
-                        bar.close()
-                    # fit() never sees this partial epoch; book its step time
-                    self.goodput.add("step", time.perf_counter() - t_epoch)
-                    self._preempt_exit_mid_epoch(epoch, done)
+                if done < steps:
+                    # control barrier first (see the device-mode loop);
+                    # the finally below joins the prefetcher on unwind
+                    roll_reqs = self._control_barrier(epoch, step=done)
+                    if roll_reqs is not None:
+                        if bar is not None:
+                            bar.close()
+                        self.goodput.add(
+                            "step", time.perf_counter() - t_epoch
+                        )
+                        raise MidEpochRollback(
+                            epoch=epoch, steps_done=done, requests=roll_reqs
+                        )
+                    if self._preempt_due(epoch, step=done, start_offset=offset):
+                        if bar is not None:
+                            bar.close()
+                        # fit() never sees this partial epoch; book its
+                        # step time
+                        self.goodput.add("step", time.perf_counter() - t_epoch)
+                        self._preempt_exit_mid_epoch(epoch, done)
         finally:
             # preemption drain / error unwind must join the staging thread
             if isinstance(chunks, DevicePrefetcher):

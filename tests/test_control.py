@@ -1,0 +1,344 @@
+"""Mid-epoch control plane tests (ISSUE 18): the chunk-boundary control
+channel, scheduler-probe re-admission, and the decide->apply audit gate.
+
+The load-bearing properties pinned here:
+
+- the ``control-{action}.req`` channel round-trips: rename-atomic write,
+  one-shot consumption, an UNCONSUMED file winning over a new decision,
+  and a torn file degrading to the bare action (never a crash);
+- attempt-scoped (drain-class) requests from an earlier attempt are
+  stale — a drain decided before a supervisor restart must not drain the
+  healthy relaunch (one-shot ACROSS restarts, not just within one);
+- every registered policy action declares its application boundary
+  (the :data:`ops.policy.ACTION_BOUNDARY` lint);
+- ``SchedulerProbe`` parses ``file:``/``exec:`` specs, substitutes
+  ``{host}``, and degrades PERMANENTLY with exactly one warning when the
+  probe infrastructure itself breaks;
+- :func:`control.unapplied_actions` flags an acted rollback/abort whose
+  decision completed but never produced an ``applied`` control event —
+  and nothing else;
+- the tentpole identity: a mid-epoch (chunk-boundary) rollback restores
+  the SAME verified checkpoint the legacy epoch-boundary path does, so
+  two runs differing only in ``--control-boundary`` finish with
+  identical parameters — the chunk path just gets there within one
+  chunk of the decision instead of an epoch later.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import run_report  # noqa: E402
+
+from distributed_training_comparison_tpu import obs
+from distributed_training_comparison_tpu.config import load_config
+from distributed_training_comparison_tpu.ops import policy as P
+from distributed_training_comparison_tpu.resilience import control
+from distributed_training_comparison_tpu.resilience.faults import (
+    SchedulerProbe,
+)
+
+
+# ------------------------------------------------- the control channel
+
+
+def test_control_request_roundtrip_and_one_shot(tmp_path):
+    path = control.write_control_request(
+        tmp_path, "rollback", {"id": "r-1", "rule": "loss"}, attempt=2
+    )
+    assert path is not None and path.name == "control-rollback.req"
+    assert not list(tmp_path.glob("fleet/*.tmp"))  # rename-atomic
+    # an unconsumed request wins: the second decision coalesces
+    assert control.write_control_request(
+        tmp_path, "rollback", {"id": "r-2"}
+    ) is None
+    # non-consuming read sees it...
+    [pend] = control.pending_control(tmp_path)
+    assert pend["id"] == "r-1" and pend["attempt"] == 2
+    assert isinstance(pend["t_decide"], float)  # stamped at write
+    # ...the poller consumes it exactly once
+    poller = control.ControlPoller(tmp_path)
+    [req] = poller.poll()
+    assert req["id"] == "r-1" and req["action"] == "rollback"
+    assert poller.poll() == []
+    assert control.pending_control(tmp_path) == []
+
+
+def test_control_request_rejects_unknown_action(tmp_path):
+    with pytest.raises(ValueError):
+        control.write_control_request(tmp_path, "reboot_universe", {})
+
+
+def test_torn_control_file_degrades_to_bare_action(tmp_path):
+    control.write_control_request(tmp_path, "drain", {"id": "d-1"})
+    f = tmp_path / control.CONTROL_DIRNAME / "control-drain.req"
+    f.write_text(f.read_text()[:5])  # torn mid-write
+    [req] = control.ControlPoller(tmp_path).poll()
+    assert req == {"action": "drain"}
+
+
+def test_clear_control_requests_sweeps_every_action(tmp_path):
+    control.write_control_request(tmp_path, "drain", {})
+    control.write_control_request(tmp_path, "rollback", {})
+    assert control.clear_control_requests(tmp_path) == 2
+    assert control.pending_control(tmp_path) == []
+    assert control.clear_control_requests(tmp_path) == 0
+
+
+def test_stale_drain_is_one_shot_across_restarts(tmp_path):
+    """A drain decided in attempt 0 but consumed in attempt 1 already got
+    its attempt boundary (the supervisor restart won the race): applying
+    it would drain the healthy relaunch into a restart loop."""
+    control.write_control_request(
+        tmp_path, "drain", {"id": "d-1", "verb": "drain_host"}, attempt=0
+    )
+    [req] = control.ControlPoller(tmp_path).poll()
+    assert control.is_stale(req, 1)  # later attempt: superseded
+    assert not control.is_stale(req, 0)  # same attempt: applies
+    # rollback/abort deliberately survive restarts — the relaunch
+    # restores the state the decision revokes, so it still stands
+    roll = dict(req, action="rollback")
+    assert not control.is_stale(roll, 5)
+    # a hand-written file with no attempt stamp never ages out (markers
+    # written by operators must keep working)
+    assert not control.is_stale({"action": "drain"}, 5)
+
+
+def test_every_action_declares_a_boundary():
+    """The ACTION_BOUNDARY lint: registering a policy action without
+    saying WHERE it applies is how the next action silently falls back
+    to whole-epoch blast radius."""
+    assert set(P.ACTION_BOUNDARY) == set(P.ACTIONS)
+    assert set(P.ACTION_BOUNDARY.values()) <= {"immediate", "chunk"}
+    # the trainer-consumed control actions are exactly the chunk ones
+    # that travel as requests (drain-class verbs share the drain file)
+    for action in P.REQUEST_ACTIONS:
+        assert P.ACTION_BOUNDARY[action] == "chunk"
+
+
+# --------------------------------------------- scheduler re-admission
+
+
+def test_probe_file_spec_substitutes_host(tmp_path):
+    probe = SchedulerProbe(f"file:{tmp_path}/ready-{{host}}")
+    assert not probe.check(1)
+    (tmp_path / "ready-1").touch()
+    assert probe.check(1)
+    assert not probe.check(2)  # per-host, not fleet-wide
+
+
+def test_probe_exec_spec_exit_code_is_the_signal(tmp_path):
+    ok = tmp_path / "ready"
+    probe = SchedulerProbe(f"exec:test -e {ok} # {{host}}")
+    assert not probe.check(1)  # nonzero exit = "not yet", NOT a failure
+    assert not probe._failed
+    ok.touch()
+    assert probe.check(1)
+
+
+def test_probe_exec_appends_host_when_not_templated(tmp_path):
+    marker = tmp_path / "argv"
+    probe = SchedulerProbe(f"exec:echo > {marker}")
+    assert probe.check(3)
+    assert marker.read_text().strip() == "3"  # the argv tail IS the host
+
+
+def test_probe_degrades_once_with_one_warning():
+    warnings = []
+    probe = SchedulerProbe("ready-file-no-kind", log=warnings.append)
+    assert probe._failed
+    assert not probe.check(1) and not probe.check(2)
+    assert len(warnings) == 1  # ONE warning, however often it's polled
+    assert "manual host-i.up marker path" in warnings[0]
+    # both malformed shapes: missing kind and empty arg
+    bad = SchedulerProbe("file:", log=warnings.append)
+    assert bad._failed and len(warnings) == 2
+
+
+# -------------------------------------------- the decide->apply audit
+
+
+def _policy_completed(pid, action, **extra):
+    return {
+        "kind": "policy",
+        "t": 1.0,
+        "payload": {
+            "state": "completed", "id": pid, "action": action, **extra,
+        },
+    }
+
+
+def _control_applied(pid, state="applied", **extra):
+    return {
+        "kind": "control",
+        "t": 2.0,
+        "payload": {
+            "state": state, "id": pid, "action": "rollback",
+            "boundary": "chunk", **extra,
+        },
+    }
+
+
+def test_unapplied_actions_flags_the_broken_trail():
+    events = [
+        _policy_completed("a-1", "rollback"),           # never applied
+        _policy_completed("a-2", "rollback"),           # applied: clean
+        _control_applied("a-2"),
+        _policy_completed("a-3", "drain_host"),         # supervisor-side
+        _policy_completed("a-4", "rollback", dry_run=True),  # no action
+        _policy_completed("a-5", "abort_with_evidence"),
+        _control_applied("a-5", state="superseded"),    # terminal too
+    ]
+    assert [p["id"] for p in control.unapplied_actions(events)] == ["a-1"]
+    assert control.unapplied_actions([]) == []
+
+
+# --------------------------------------- the tentpole identity (e2e)
+
+
+def _rollback_argv(root, boundary):
+    spike = "train/loss:p95>50:for=1"
+    return [
+        "--synthetic-data", "--limit-examples", "256",
+        "--batch-size", "32", "--epoch", "4",
+        "--save-last-min-secs", "0", "--no-progress", "--seed", "7",
+        "--device-chunk-steps", "2", "--eval-step", "1000",
+        # flush (= alert evaluation) at every chunk boundary: the
+        # decision's step position is deterministic, not a race between
+        # wall clock and the default 50-step flush budget
+        "--metrics-flush-steps", "2",
+        "--ckpt-path", str(root),
+        # the spike lands mid-epoch 2, AFTER verified saves exist —
+        # eligible for the chunk boundary (pre-first-save decisions are
+        # deliberately deferred to the epoch boundary)
+        "--fault-plan", "loss_spike@epoch=2:scale=64:steps=3",
+        "--health-spike-mads", "1e9",
+        "--alert", spike,
+        "--policy", f"{spike} -> rollback:cooldown=9999",
+        "--policy-mode", "act",
+        "--control-boundary", boundary,
+    ]
+
+
+@pytest.mark.health
+def test_midepoch_rollback_restores_the_same_state(tmp_path):
+    """Two runs, identical except for WHERE the rollback applies: the
+    chunk-boundary path unwinds mid-epoch, the epoch-boundary path waits
+    the epoch out — both restore the SAME verified checkpoint and replay
+    deterministically, so final params are identical.  The chunk path's
+    control event additionally proves the decision applied within one
+    chunk of its decide timestamp."""
+    import jax
+    from flax import serialization
+
+    from distributed_training_comparison_tpu.train import Trainer
+    from test_train import TinyNet
+
+    finals = {}
+    for boundary in ("chunk", "epoch"):
+        root = tmp_path / boundary
+        hp = load_config("tpu", argv=_rollback_argv(root, boundary))
+        trainer = Trainer(hp, model=TinyNet(num_classes=100))
+        try:
+            trainer.fit()
+        finally:
+            trainer.close()
+        events = obs.load_events(root / "version-0" / "events.jsonl")
+        applied = [
+            e["payload"] for e in events
+            if e["kind"] == "control"
+            and e["payload"]["state"] == "applied"
+        ]
+        assert len(applied) == 1, f"{boundary}: {applied}"
+        assert applied[0]["action"] == "rollback"
+        assert applied[0]["boundary"] == boundary
+        assert applied[0]["mid_epoch"] is (boundary == "chunk")
+        assert applied[0]["ttm_s"] >= 0.0
+        if boundary == "chunk":
+            # the tentpole gate: mitigation within ONE chunk (2 steps)
+            assert applied[0]["steps_since_decide"] <= 2
+        assert any(e["kind"] == "rollback" for e in events)
+        assert control.unapplied_actions(events) == []
+        # the decide->apply trail satisfies the report gate end to end
+        assert run_report.main([str(root), "--policy"]) == 0
+        raw = serialization.msgpack_restore(
+            (root / "version-0" / "last.ckpt").read_bytes()
+        )
+        assert raw["epoch"] == 3  # all 4 epochs completed post-replay
+        finals[boundary] = raw["state"]["params"]
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        finals["chunk"],
+        finals["epoch"],
+    )
+
+
+@pytest.mark.health
+def test_pre_save_rollback_defers_at_the_barrier(tmp_path):
+    """A rollback decided BEFORE the first verified checkpoint has no
+    target: the chunk barrier must neither unwind a chunk loop with
+    nothing to restore, nor livelock re-examining the request at every
+    boundary, nor fail a decision that becomes viable one save later —
+    it parks the request for the epoch boundary (the legacy path) and
+    skips it thereafter."""
+    from distributed_training_comparison_tpu.train import Trainer
+    from test_train import TinyNet
+
+    always = "train/loss:p95>-1:for=1"
+    hp = load_config(
+        "tpu",
+        argv=[
+            "--synthetic-data", "--limit-examples", "128",
+            "--batch-size", "32", "--epoch", "3",
+            "--save-last-min-secs", "0", "--no-progress", "--seed", "7",
+            "--device-chunk-steps", "2", "--eval-step", "1000",
+            "--ckpt-path", str(tmp_path),
+            "--alert", always,
+            "--policy", f"{always} -> rollback:cooldown=9999",
+            "--policy-mode", "act",
+            "--control-boundary", "chunk",
+        ],
+    )
+    trainer = Trainer(hp, model=TinyNet(num_classes=100))
+    try:
+        assert trainer.policy_engine is not None
+        trainer._policy_requests.append(
+            {"action": "rollback", "id": "pre-1", "rule": always,
+             "t_decide": 0.0}
+        )
+        # no verified save exists: parked for the epoch boundary
+        assert trainer._control_barrier(0, step=2) is None
+        [parked] = trainer._policy_requests
+        assert parked["_epoch_only"] and parked["id"] == "pre-1"
+        # one-shot deferral: later boundaries skip the parked request
+        # (no livelock) and leave it queued for _apply_policy_requests
+        assert trainer._control_barrier(0, step=4) is None
+        [still] = trainer._policy_requests
+        assert still["id"] == "pre-1"
+    finally:
+        trainer.close()
+
+
+def test_chaos_catalog_carries_the_control_scenarios():
+    from distributed_training_comparison_tpu.resilience import (
+        CHAOS_SCENARIOS,
+    )
+
+    assert "control_rollback" in CHAOS_SCENARIOS
+    assert "probe_readmission" in CHAOS_SCENARIOS
+    ctl = CHAOS_SCENARIOS["control_rollback"]
+    assert ctl["expect"]["control_mid_epoch__min"] >= 1
+    assert "control" in ctl["require_kinds"]
+    probe = CHAOS_SCENARIOS["probe_readmission"]
+    # re-admission must come from the probe, not an operator marker:
+    # the driver never writes host-1.up in this scenario
+    assert any("--fleet-probe" in a for a in probe["extra_args"])
+    assert probe["expect"]["resizes__min"] >= 2

@@ -606,12 +606,26 @@ def _policy_event(state, pid, t, action="rollback"):
     }
 
 
+def _control_event(state, pid, t, action="rollback", **extra):
+    return {
+        "v": 1, "run_id": "r" * 16, "attempt": 0, "process_index": 0,
+        "t_wall": t, "t_mono": t, "kind": "control",
+        "payload": {
+            "state": state, "id": pid, "action": action,
+            "boundary": "chunk", "mid_epoch": True,
+            "t_decide": t - 1.0, "t_apply": t, "ttm_s": 1.0, **extra,
+        },
+    }
+
+
 def test_run_report_policy_exit_codes(tmp_path, capsys):
     events = tmp_path / "events.jsonl"
-    # completed pair + an informational dry-run: rc 0
+    # completed pair + its applied control event + an informational
+    # dry-run: rc 0
     rows = [
         _policy_event("requested", "a0-1", 1.0),
         _policy_event("completed", "a0-1", 2.0),
+        _control_event("applied", "a0-1", 2.0, steps_since_decide=2),
         dict(_policy_event("dry_run", "a0-2", 3.0), payload={
             "state": "dry_run", "id": "a0-2", "action": "drain_host",
             "rule": "m -> drain_host", "dry_run": True,
@@ -621,11 +635,21 @@ def test_run_report_policy_exit_codes(tmp_path, capsys):
     assert run_report.main([str(tmp_path), "--policy"]) == 0
     out = capsys.readouterr().out
     assert "COMPLETED" in out and "no action taken" in out
+    assert "APPLIED" in out and "ttm=1.000s" in out
     # a requested action with no outcome anywhere in the stream: rc 1
     rows.append(_policy_event("requested", "a0-3", 4.0))
     events.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     assert run_report.main([str(tmp_path), "--policy"]) == 1
     assert "STILL PENDING" in capsys.readouterr().out
+    # an acted decision that completed but never reached an 'applied'
+    # control event: the decide->apply trail broke mid-way, rc 1
+    rows = [
+        _policy_event("requested", "b0-1", 1.0),
+        _policy_event("completed", "b0-1", 2.0),
+    ]
+    events.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert run_report.main([str(tmp_path), "--policy"]) == 1
+    assert "NEVER APPLIED" in capsys.readouterr().out
     # no policy events at all is healthy; an empty root is rc 2
     events.write_text(json.dumps(_policy_event("x", "y", 0.0)).replace(
         '"policy"', '"metrics"'

@@ -845,11 +845,20 @@ def policy_report(path: str | Path, out=print) -> int:
     events at all — a run without ``--policy`` rules is not unhealthy),
     1 while any action is still PENDING (requested by the engine but
     never applied — the process meant to apply it died first), 2 when
-    ``path`` holds no events whatsoever."""
+    ``path`` holds no events whatsoever.
+
+    When the stream carries ``control`` events (the mid-epoch control
+    plane), each is rendered with its time-to-mitigation — seconds and
+    steps from the decision to the boundary that applied it — and the
+    gate also fails (exit 1) any acted ``rollback``/
+    ``abort_with_evidence`` decision that completed but never reached an
+    ``applied`` control event: the decision was made, the action ran,
+    but no boundary ever recorded landing it."""
     from distributed_training_comparison_tpu.ops.policy import (
         pending_actions,
         policy_timeline,
     )
+    from distributed_training_comparison_tpu.resilience import control as control_mod
 
     events, _files = load_run(path)
     if not events:
@@ -885,6 +894,26 @@ def policy_report(path: str | Path, out=print) -> int:
         if p.get("dry_run") and state == "dry_run":
             line += "  [no action taken]"
         out(line)
+    controls = control_mod.control_timeline(events)
+    if controls:
+        out("")
+        out("mid-epoch control (decide -> apply):")
+        for ev in controls:
+            p = ev.get("payload") or {}
+            line = (
+                f"[{ev.get('t_wall', 0.0) - t0:>9.3f}s] "
+                f"{str(p.get('state', '?')).upper():>10}: "
+                f"{p.get('verb') or p.get('action', '?')}"
+                f"  boundary={p.get('boundary', '?')}"
+            )
+            if p.get("ttm_s") is not None:
+                line += f" ttm={p['ttm_s']:.3f}s"
+            if p.get("steps_since_decide") is not None:
+                line += f" (+{p['steps_since_decide']} steps)"
+            if p.get("id") is not None:
+                line += f" id={p['id']}"
+            out(line)
+    rc = 0
     pending = pending_actions(events)
     if pending:
         out(
@@ -894,9 +923,21 @@ def policy_report(path: str | Path, out=print) -> int:
                 for p in pending
             )
         )
-        return 1
-    out("all requested actions completed")
-    return 0
+        rc = 1
+    unapplied = control_mod.unapplied_actions(events)
+    if unapplied:
+        out(
+            "NEVER APPLIED: "
+            + ", ".join(
+                f"{p.get('action', '?')} (id {p.get('id', '?')})"
+                for p in unapplied
+            )
+            + "  — acted decisions with no 'applied' control event"
+        )
+        rc = 1
+    if rc == 0:
+        out("all requested actions completed")
+    return rc
 
 
 def serve_class_table(events: list[dict]) -> dict[str, dict]:
