@@ -228,6 +228,18 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "microbatch count, same O(P) stash",
     )
     parser.add_argument(
+        "--pipeline-resident-layout",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="Carry the trunk stack in the schedule's native layout "
+        "(parallel/layouts.py): under --pipeline-schedule interleaved "
+        "with virtual stages the TrainState holds the (v, P, K) chunk "
+        "view, deleting the per-step relayout from the hot path "
+        "(checkpoints stay canonical/contiguous on disk either way). "
+        "--no-pipeline-resident-layout keeps the legacy per-step "
+        "relayout — the bench baseline (bench.py --relayout)",
+    )
+    parser.add_argument(
         "--precision",
         type=str,
         default=None,

@@ -67,6 +67,17 @@ from .ring import (
     sequence_vit_apply,
     ulysses_attention,
 )
+from .layouts import (
+    CONTIGUOUS,
+    ChunkedLayout,
+    StateLayout,
+    layout_for,
+    layout_tag_for,
+    state_from_canonical,
+    state_to_canonical,
+    tree_from_canonical,
+    tree_to_canonical,
+)
 from .pipeline import (
     make_1f1b_fwd_bwd,
     make_interleaved_fwd_bwd,
@@ -115,6 +126,15 @@ __all__ = [
     "make_ulysses_attention",
     "sequence_vit_apply",
     "make_sequence_apply_fn",
+    "CONTIGUOUS",
+    "ChunkedLayout",
+    "StateLayout",
+    "layout_for",
+    "layout_tag_for",
+    "state_from_canonical",
+    "state_to_canonical",
+    "tree_from_canonical",
+    "tree_to_canonical",
     "pipeline_stages",
     "make_1f1b_fwd_bwd",
     "make_interleaved_fwd_bwd",

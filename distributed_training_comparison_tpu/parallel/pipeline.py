@@ -271,26 +271,30 @@ def pp_state_shardings(
     pipe_axis: str = MODEL_AXIS,
     blocks_key: str = "blocks",
     tp_axis: str | None = None,
+    state_layout=None,
 ):
     """``TrainState`` shardings for the pipeline layout: the stacked trunk
-    (leading ``depth`` axis) is sharded across pipeline stages — and, under
-    the DP×TP×PP composition (``tp_axis``), its feature dims across the
-    tensor-parallel axis — everything else (embed/head params, (empty)
-    batch stats) is replicated; the optimizer's momentum mirrors the params
-    via the shared suffix-matching builder (``tp.build_state_shardings``).
+    is sharded across pipeline stages — and, under the DP×TP×PP
+    composition (``tp_axis``), its feature dims across the tensor-parallel
+    axis — everything else (embed/head params, (empty) batch stats) is
+    replicated; the optimizer's momentum mirrors the params via the shared
+    suffix-matching builder (``tp.build_state_shardings``).
 
-    The CARRIED trunk layout is always the contiguous pipe-sharded stack
-    (stage ``s`` holds layers ``[s·L/P, (s+1)·L/P)``); the interleaved
-    schedule re-lays its ``(v, P, K)`` chunk view in-program (a
-    sharding-constraint relayout at the dispatch boundary), so eval /
-    checkpointing / GPipe all see one state layout regardless of the
-    training schedule."""
+    The CARRIED trunk layout is whatever the installed schedule declares
+    (``parallel/layouts.py``): the contiguous pipe-sharded stack for
+    GPipe/1F1B (stage ``s`` holds layers ``[s·L/P, (s+1)·L/P)``), the
+    resident ``(v, P, K)`` chunk view for the interleaved schedule — so
+    the per-step relayout is gone and ``state.params[blocks_key]`` must
+    already be in ``state_layout``'s resident form when this is called.
+    ``state_layout=None`` keeps the legacy contiguous specs."""
     from .tp import build_state_shardings
 
     repl = P()
 
     def pspec(mod, sub):
         if mod == blocks_key:
+            if state_layout is not None:
+                return state_layout.specs(sub)
             return pp_trunk_specs(sub, pipe_axis=pipe_axis, tp_axis=tp_axis)
         return jax.tree_util.tree_map(lambda _: repl, sub)
 
@@ -306,16 +310,23 @@ def make_pipelined_apply_fn(
     num_microbatches: int,
     pipe_axis: str = MODEL_AXIS,
     tp_axis: str | None = None,
+    state_layout=None,
 ):
     """An ``apply_fn`` drop-in for ``TrainState`` that runs the pipelined
     forward with the train step's calling conventions (``train=``,
-    ``mutable=`` — the transformer family has no mutable collections)."""
+    ``mutable=`` — the transformer family has no mutable collections).
+
+    ``state_layout``: the resident trunk layout the carried variables
+    arrive in; a chunked-resident trunk is canonicalized per eval batch
+    (off the train hot path — the one reader that still pays a relayout,
+    documented in ``parallel/layouts.py``)."""
 
     def apply_fn(variables, x, train=False, mutable=()):
         logits = pipelined_vit_apply(
             model, variables, x, mesh,
             num_microbatches=num_microbatches,
             pipe_axis=pipe_axis, tp_axis=tp_axis,
+            state_layout=state_layout,
         )
         return (logits, {}) if mutable else logits
 
@@ -761,6 +772,7 @@ def pipeline_residual_spec(
     tp_axis: str | None = None,
     data_axis: str = DATA_AXIS,
     blocks_key: str = "blocks",
+    state_layout=None,
 ):
     """``(host_zeros, shardings)`` for the pipeline wire's error-feedback
     residual, laid out exactly as the schedule computes it: per-DEVICE
@@ -773,12 +785,19 @@ def pipeline_residual_spec(
     NOT params-shaped (unlike the GSPMD comms residual): the wire error is
     device-local by construction.  Like every comms residual it is never
     checkpointed — resume/rollback restart it at zero.
+
+    ``state_layout``: the resident layout ``params`` arrives in — the
+    shapes here derive from the canonical depth, so a resident-chunked
+    trunk is canonicalized first (callers pass host/abstract trees; the
+    reshape is free).  The residual itself stays chunk-laid either way.
     """
     import numpy as np
 
     d_size = int(mesh.shape[data_axis])
     p_size = int(mesh.shape[pipe_axis])
     blocks = params[blocks_key]
+    if state_layout is not None:
+        blocks = state_layout.canonicalized(blocks)
     depth = jax.tree_util.tree_leaves(blocks)[0].shape[0]
     k = depth // (virtual * p_size)
     head_params = {kk: vv for kk, vv in params.items() if kk != blocks_key}
@@ -818,6 +837,7 @@ def make_interleaved_fwd_bwd(
     tp_axis: str | None = None,
     grad_comms: str = "fp32",
     head_all_stages: bool = False,
+    state_layout=None,
 ):
     """Build the (interleaved-)1F1B forward+backward for a zoo ViT.
 
@@ -833,10 +853,15 @@ def make_interleaved_fwd_bwd(
     under outer autodiff, head inside the schedule on the last stage —
     and ONLY there, under ``lax.cond``).
 
-    ``virtual > 1`` is the interleaved schedule: the carried contiguous
-    pipe-sharded stack is re-laid to the ``(v, P, K)`` chunk view at the
-    schedule boundary (one sharding-constraint relayout per step; with
-    ``v == 1`` the two layouts coincide and the constraint is free).
+    ``state_layout`` (``parallel/layouts.py``) declares the layout
+    ``params["blocks"]`` ARRIVES in.  With a chunked layout the trunk is
+    already the resident ``(v, P, K)`` chunk view the schedule consumes —
+    no per-step relayout; gradients return in the same layout.  With
+    ``None``/contiguous (the legacy baseline, and the ``v == 1`` case
+    where the layouts coincide) the carried contiguous stack is re-laid
+    to the chunk view at the schedule boundary (one sharding-constraint
+    relayout per step — an all-to-all of the trunk params on real
+    silicon; free only for ``v == 1``).
     """
     import optax
 
@@ -845,6 +870,15 @@ def make_interleaved_fwd_bwd(
     v = int(virtual)
     if v < 1:
         raise ValueError(f"virtual stages must be >= 1, got {v}")
+    resident = (
+        state_layout is not None
+        and getattr(state_layout, "kind", "contiguous") == "chunked"
+    )
+    if resident and (state_layout.virtual != v or state_layout.pipe != p_size):
+        raise ValueError(
+            f"state layout {state_layout.tag} does not match the schedule "
+            f"(v={v}, P={p_size})"
+        )
     if model.depth % (v * p_size):
         raise ValueError(
             f"model depth ({model.depth}) must divide into "
@@ -887,15 +921,24 @@ def make_interleaved_fwd_bwd(
         # outer embed_vjp)
         head_params = {kk: vv for kk, vv in params.items() if kk != "blocks"}
 
-        # the (v, P, K) chunk view: chunk c = i*P + s at [i, s] — layer
-        # order i-major means the reshape IS the chunk assignment; the
-        # sharding constraint is the (documented) relayout for v > 1
-        chunked = jax.tree_util.tree_map(
-            lambda l: l.reshape(v, p_size, k, *l.shape[1:]), params["blocks"]
-        )
-        chunk_specs = _chunk_view_specs(
-            params["blocks"], pipe_axis=pipe_axis, tp_axis=tp_axis
-        )
+        if resident:
+            # schedule-native resident layout: the carried trunk IS the
+            # (v, P, K) chunk view — nothing to re-lay, nothing to
+            # constrain; the specs name the layout the state already has
+            chunked = params["blocks"]
+            chunk_specs = state_layout.specs(params["blocks"])
+        else:
+            # the (v, P, K) chunk view: chunk c = i*P + s at [i, s] —
+            # layer order i-major means the reshape IS the chunk
+            # assignment; the sharding constraint is the (documented)
+            # relayout for v > 1
+            chunked = jax.tree_util.tree_map(
+                lambda l: l.reshape(v, p_size, k, *l.shape[1:]),
+                params["blocks"],
+            )
+            chunk_specs = _chunk_view_specs(
+                params["blocks"], pipe_axis=pipe_axis, tp_axis=tp_axis
+            )
         head_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
         mb_spec = P(None, data_axis, *([None] * (mb.ndim - 2)))
         lb_spec = P(None, data_axis)
@@ -972,9 +1015,14 @@ def make_interleaved_fwd_bwd(
 
         dtokens = dtok.reshape(b, *tokens.shape[1:])
         grads = dict(embed_vjp(dtokens)[0])  # embed grads; zeros elsewhere
-        grads["blocks"] = jax.tree_util.tree_map(
-            lambda g, p_: g.reshape(p_.shape), g_chunks, params["blocks"]
-        )
+        if resident:
+            # grads stay in the resident chunk layout — they already
+            # match params["blocks"] leaf-for-leaf, shape-for-shape
+            grads["blocks"] = g_chunks
+        else:
+            grads["blocks"] = jax.tree_util.tree_map(
+                lambda g, p_: g.reshape(p_.shape), g_chunks, params["blocks"]
+            )
         for kk in _HEAD_MODS:
             grads[kk] = g_head[kk]
         out = (loss_v, logits.reshape(b, *logits.shape[2:]), grads)
@@ -986,6 +1034,7 @@ def make_interleaved_fwd_bwd(
     fwd_bwd.schedule_meta = schedule_meta(
         "interleaved" if v > 1 else "1f1b", p_size, num_microbatches, v
     )
+    fwd_bwd.state_layout = state_layout
     return fwd_bwd
 
 
@@ -1020,6 +1069,7 @@ def pipelined_vit_apply(
     pipe_axis: str = MODEL_AXIS,
     data_axis: str | None = DATA_AXIS,
     tp_axis: str | None = None,
+    state_layout=None,
 ) -> jnp.ndarray:
     """Forward a zoo ViT with its trunk pipelined over ``pipe_axis`` (and,
     with ``tp_axis``, tensor-parallel inside each stage).
@@ -1027,6 +1077,11 @@ def pipelined_vit_apply(
     Embed and head run as ordinary (data-parallel) computations via the
     model's own methods on the same ``variables``; only the trunk is
     staged.  Semantically identical to ``model.apply(variables, images)``.
+
+    ``state_layout``: the resident layout the carried trunk arrives in.
+    GPipe consumes the contiguous stack, so a chunked-resident trunk
+    (interleaved training) is canonicalized here — one relayout per eval
+    batch, the price of keeping the TRAIN hot path relayout-free.
     """
     p_size = mesh.shape[pipe_axis]
     if model.depth % p_size:
@@ -1035,6 +1090,8 @@ def pipelined_vit_apply(
         )
     tokens = model.apply(variables, images, method="embed")
     blocks = variables["params"]["blocks"]
+    if state_layout is not None:
+        blocks = state_layout.canonicalized(blocks)
     trunk = make_pipeline_trunk(
         mesh,
         # manual_vjp=False: GPipe's backward is OUTER autodiff through the

@@ -105,6 +105,13 @@ HBM_GATE_FRAC = 0.9
 # the event must stay well under the bus's oversize-stub bound
 PLAN_EVENT_CANDIDATES = 12
 
+# the runtime carries the trunk stack RESIDENT in the schedule's native
+# layout (parallel/layouts.py), so interleaved v>1 candidates pay no
+# per-step chunk relayout — predict() prices the term and zeroes it when
+# this is active.  --no-pipeline-resident-layout (the bench baseline)
+# flips it back per run; plan_layout reads the hparams flag.
+SCHEDULE_NATIVE_STATE_LAYOUT = True
+
 
 class PlanError(ValueError):
     """No feasible layout exists for this device count / batch / model.
@@ -290,11 +297,18 @@ class Candidate:
 
     def layout(self) -> dict:
         """The comparison key ``run_report --plan`` checks against the
-        attempt's ``run_start`` payload (its ``mesh`` + comms flags)."""
+        attempt's ``run_start`` payload (its ``mesh`` + comms flags +
+        resident state layout)."""
+        from .layouts import layout_tag_for
+
         return {
             "data": self.data, "model": self.model, "pipe": self.pipe,
             "shard_optim": bool(self.shard_optim),
             "grad_comms": self.grad_comms,
+            "state_layout": layout_tag_for(
+                self.schedule if self.pipe > 1 else None,
+                virtual=self.virtual, pipe=self.pipe,
+            ),
         }
 
     def flags(self) -> list[str]:
@@ -679,10 +693,17 @@ def predict(
     *,
     batch_size: int,
     ledger: LedgerFit | None = None,
+    native_layout: bool = SCHEDULE_NATIVE_STATE_LAYOUT,
 ) -> Candidate:
     """Fill in the candidate's predicted step seconds / HBM from the cost
     model.  Every term lands in ``cand.terms`` so the plan event (and
-    ``run_report --plan``) can show WHY a layout won."""
+    ``run_report --plan``) can show WHY a layout won.
+
+    ``native_layout``: whether the run carries the trunk resident in the
+    schedule's layout (``parallel/layouts.py``).  When False (the legacy
+    per-step relayout) interleaved v>1 candidates pay term (4) below —
+    without it they were silently under-priced relative to measured step
+    seconds."""
     # --- compute: global step flops / devices, ledger flops preferred.
     # The scale-from-ledger step assumes the same global batch; callers
     # that change the batch re-fit.
@@ -735,7 +756,27 @@ def predict(
         if cand.pipe > 1 and act_bytes
         else 0.0
     )
-    comms_s = (sync_bytes + tp_bytes + pp_bytes) / cost.wire_bytes_per_s
+    # (4) the per-step chunk relayout of the LEGACY interleaved path: the
+    #     sharding-constraint reshape to the (v, P, K) chunk view moves
+    #     every trunk layer whose stage assignment differs between the
+    #     contiguous and round-robin-chunk layouts — a (1 - 1/v) fraction
+    #     of the (TP-sharded) trunk params, each way (params in, grads
+    #     back), every step.  Zero under the schedule-native resident
+    #     layout (the relayout happens once at construction/restore) and
+    #     for v=1, where the two layouts coincide.  The term is always
+    #     recorded so the plan event shows what the resident layout saved.
+    relayout_bytes = (
+        2.0 * (1.0 - 1.0 / cand.virtual) * spec.param_bytes() / cand.model
+        if cand.pipe > 1 and cand.virtual > 1
+        else 0.0
+    )
+    relayout_s = (
+        0.0 if native_layout else relayout_bytes / cost.wire_bytes_per_s
+    )
+    comms_s = (
+        (sync_bytes + tp_bytes + pp_bytes) / cost.wire_bytes_per_s
+        + relayout_s
+    )
     cand.predicted_step_s = compute_s + comms_s
     cand.terms = {
         "compute_s": compute_s,
@@ -744,6 +785,9 @@ def predict(
         "sync_bytes": sync_bytes,
         "tp_act_bytes": tp_bytes,
         "pp_act_bytes": pp_bytes,
+        "relayout_bytes": relayout_bytes,
+        "relayout_s": relayout_s,
+        "native_layout": bool(native_layout),
         "flops_source": flops_src,
         "per_device_flops": per_dev,
     }
@@ -895,8 +939,14 @@ def plan_layout(
                 batch_size, devices, grad_accum
             ))
         )
+    native_layout = bool(
+        getattr(hparams, "pipeline_resident_layout", SCHEDULE_NATIVE_STATE_LAYOUT)
+    )
     scored = [
-        predict(c, cost, spec, batch_size=batch_size, ledger=ledger)
+        predict(
+            c, cost, spec, batch_size=batch_size, ledger=ledger,
+            native_layout=native_layout,
+        )
         for c in cands
     ]
     # the HBM feasibility gate, when the ledger knows the limit
@@ -966,6 +1016,12 @@ def install_plan(plan: Plan, hparams) -> dict:
         set_field("pipeline_schedule", c.schedule)
         set_field("pipeline_microbatches", c.microbatches)
         set_field("pipeline_virtual_stages", c.virtual)
+        if c.virtual > 1:
+            # thread the chosen resident layout: a replanned resize onto
+            # an interleaved winner lands with the chunk view resident
+            # (the layout the candidate was priced at — its relayout term
+            # was zeroed on this assumption)
+            set_field("pipeline_resident_layout", True)
     return changed
 
 

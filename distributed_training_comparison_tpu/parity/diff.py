@@ -376,6 +376,7 @@ def run_parity_check(
     eager_state=None,
     eager_unsupported_reason: str | None = None,
     layout: dict | None = None,
+    canonicalize_state=None,
 ) -> dict:
     """Run both gates over a completed capture; returns the ``parity``
     event payload (see module docstring for the gate semantics).
@@ -387,7 +388,13 @@ def run_parity_check(
     (``parity/eager.py``); ``None`` marks the reference gate unsupported
     for this layout, with ``eager_unsupported_reason`` naming why.
     ``place_state`` places the host-side initial snapshot onto the run's
-    real layout (defaults to an uncommitted ``jax.device_put``)."""
+    real layout (defaults to an uncommitted ``jax.device_put``).
+    ``canonicalize_state`` maps the replayed state to the canonical trunk
+    layout before the eager diff (``parallel/layouts.py``): the eager rail
+    always speaks contiguous, so a chunk-resident run hands its state
+    through this hook — a bitwise-neutral reshape that preserves leaf
+    order, keeping ``capture.leaf_paths`` valid.  The replay gate itself
+    never canonicalizes: both sides of that comparison are resident."""
     assert capture.complete and capture.initial is not None
     tol = capture.tol
     paths = capture.leaf_paths
@@ -434,6 +441,8 @@ def run_parity_check(
         if eager_ok and ref_div is None:
             estate, emetrics = eager_step(estate, rec)
             chost = jax.device_get(cstate)
+            if canonicalize_state is not None:
+                chost = canonicalize_state(chost)
             loss_dist = ulp_distance(
                 np.asarray(jax.device_get(cmetrics["loss"]), np.float32),
                 np.asarray(emetrics["loss"], np.float32),

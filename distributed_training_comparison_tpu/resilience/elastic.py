@@ -185,6 +185,7 @@ def validate_reshard(
     grad_accum: int = 1,
     shard_optim: bool = False,
     pipeline: dict | None = None,
+    state_layout: str | None = None,
 ) -> dict:
     """The explicit reshard step of an elastic restore: validate the saved
     mesh against the re-rendered one and the global batch against the new
@@ -259,6 +260,15 @@ def validate_reshard(
     # before the comms layer carry no key; treated as "unchanged".
     saved_shard_optim = (manifest or {}).get("shard_optim")
     saved_pipe = (saved_mesh or {}).get("pipe") if saved_mesh else None
+    # the state-layout half: checkpoints are CANONICAL on disk whatever
+    # resident layout the saving schedule carried (parallel/layouts.py),
+    # so restoring across a layout change (v change, pp resize,
+    # chunked<->contiguous) is always legal — the restoring run
+    # re-residents through its own layout seam.  Recorded here so the
+    # restore log and run_report can say a re-layout happened.  Old
+    # manifests carry no key; treated as "unchanged".
+    saved_state_layout = (manifest or {}).get("state_layout")
+    now_state_layout = str(state_layout) if state_layout is not None else "contiguous"
     return {
         "changed": changed,
         "saved_mesh": saved_mesh,
@@ -278,6 +288,12 @@ def validate_reshard(
         "shard_optim_changed": (
             saved_shard_optim is not None
             and bool(saved_shard_optim) != bool(shard_optim)
+        ),
+        "saved_state_layout": saved_state_layout,
+        "state_layout": now_state_layout,
+        "state_layout_changed": (
+            saved_state_layout is not None
+            and str(saved_state_layout) != now_state_layout
         ),
     }
 

@@ -286,11 +286,20 @@ def serve_main(hparams) -> dict:
     if n_replicas < 1:
         from ..parallel.planner import load_ledger_events
 
+        # initial sizing prices the same G/G/m tail the live autoscaler
+        # fits: an explicit --serve-scale-target is the p99 budget, else
+        # the class deadlines are (plan_serve's own fallback chain)
+        from .fleet.autoscale import parse_scale_targets
+
+        scale_spec = getattr(hparams, "serve_scale_target", None)
         plan = plan_serve(
             load_ledger_events(hparams.ckpt_path),
             buckets=buckets,
             rate_rps=float(getattr(hparams, "serve_rate", 0.0) or 0.0),
             classes=classes,
+            scale_targets=(
+                parse_scale_targets(scale_spec) if scale_spec else None
+            ),
         )
         n_replicas = plan["replicas"]
         buckets = tuple(plan["buckets"]) or buckets

@@ -752,6 +752,7 @@ def plan_serve(
     device_kind: str | None = None,
     max_replicas: int = 8,
     utilization: float = PLAN_UTILIZATION,
+    scale_targets: dict[str, float] | None = None,
 ) -> dict:
     """Score replica count and the bucket ladder with the auto-parallel
     planner's ledger-fit cost model (``parallel/planner.py``).
@@ -760,8 +761,14 @@ def plan_serve(
     overhead_s`` (flops from the committed serve compile events; the
     slope/overhead regressed from the ledger's dispatch sketches, with
     the same peak-table/default fallbacks, recorded as ``fit.source``).
-    Replica count: offered ``rate_rps`` ÷ (per-replica capacity at the
-    best bucket × ``utilization``), clamped to ``[1, max_replicas]``.
+    Replica count: the smallest fleet whose Sakasegawa G/G/m predicted
+    p99 (``serve/fleet/autoscale.py`` — the SAME tail term the live
+    autoscaler fits) meets every p99 target, clamped to
+    ``[1, max_replicas]``.  Targets come from ``scale_targets``
+    (``--serve-scale-target``, seconds per class) when given, else each
+    class's ``deadline_ms`` is its p99 budget; with no target at all the
+    legacy utilization ceiling sizes the fleet (``sized_by:
+    "utilization"`` — the autoscaler's own thin-data fallback label).
     Ladder: buckets whose service time alone fits the tightest class
     deadline (all, when no class declares one).  Every term lands in the
     returned dict — the plan is explainable from its own payload, and
@@ -816,16 +823,63 @@ def plan_serve(
         row = per_bucket.get(str(b))
         if row is not None and row["rps"] > best_rps:
             best_rps, best_bucket = row["rps"], b
+    targets = dict(scale_targets or {})
+    if not targets:
+        # each class's deadline is its p99 budget when no explicit
+        # --serve-scale-target was given — first placement then answers
+        # the same question the attainment gate asks
+        targets = {
+            name: slo.deadline_ms / 1000.0
+            for name, slo in (classes or {}).items()
+            if slo.deadline_ms is not None
+        }
+    tail = None
     if rate_rps > 0 and best_rps > 0:
-        replicas = max(
-            1, min(int(max_replicas),
-                   math.ceil(rate_rps / (utilization * best_rps)))
-        )
-        sized_by = "ledger"
+        if targets:
+            # the G/G/m initial sizing: the ledger fit is a point
+            # estimate, so the planned service profile has cv2=0 and
+            # p99=mean — queueing variability enters through the
+            # Poisson-arrival ca2=1; the live autoscaler then refits
+            # every term from measurements
+            from .fleet import autoscale as autoscale_mod
+
+            best_row = per_bucket[str(best_bucket)]
+            service = {
+                "mean_s": best_row["service_s"],
+                "mean_batch": float(best_bucket),
+                "cv2": 0.0,
+                "p99_s": best_row["service_s"],
+                "n": autoscale_mod.MIN_TAIL_SAMPLES,
+            }
+            replicas, sized_by, rows = autoscale_mod.size_for_targets(
+                rate_rps, service, targets,
+                min_replicas=1, max_replicas=int(max_replicas),
+                ca2=1.0, classes=list(classes or ()) or None,
+            )
+            pred = autoscale_mod.predicted_p99_s(
+                rate_rps, service, replicas, ca2=1.0
+            )
+            tail = {
+                "targets_ms": {
+                    c: t * 1000.0 for c, t in targets.items()
+                },
+                "predicted_p99_ms": (
+                    None if math.isinf(pred) else pred * 1000.0
+                ),
+                "rows": rows,
+            }
+        else:
+            # no p99 target anywhere: the legacy utilization ceiling,
+            # labeled with the autoscaler's own fallback name
+            replicas = max(
+                1, min(int(max_replicas),
+                       math.ceil(rate_rps / (utilization * best_rps)))
+            )
+            sized_by = "utilization"
     else:
         replicas = 1
         sized_by = "no-rate" if best_rps > 0 else "no-serve-ledger"
-    return {
+    out = {
         "replicas": replicas,
         "buckets": ladder,
         "sized_by": sized_by,
@@ -837,3 +891,6 @@ def plan_serve(
         "per_bucket": per_bucket,
         "fit": cost.describe(),
     }
+    if tail is not None:
+        out["tail"] = tail
+    return out

@@ -25,6 +25,7 @@ from typing import Any, Callable
 import numpy as np
 from flax import serialization
 
+from ..parallel.layouts import tree_from_canonical, tree_to_canonical
 from ..parallel.sharding import fetch_to_host
 from ..resilience.ckpt_io import (
     atomic_write_bytes,
@@ -144,16 +145,29 @@ def _state_dict(state: TrainState) -> dict[str, Any]:
 # thread (see trainer.fit / parallel.needs_collective_fetch).
 
 
-def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_acc: float) -> Path:
+def save_checkpoint(
+    version_dir: str | Path,
+    state: TrainState,
+    epoch: int,
+    val_acc: float,
+    state_layout=None,
+) -> Path:
     """Best-only save: drop previous best files, write the new one.
 
     File carries params + batch_stats (what inference needs); the resumable
-    full state lives in ``last.ckpt``.
+    full state lives in ``last.ckpt``.  On disk the trunk stack is always
+    CANONICAL (contiguous depth-major): ``state_layout`` describes the
+    live state's resident layout so a chunk-resident interleaved run still
+    writes the same bytes a contiguous run would — any future run (any
+    schedule) restores it through its own layout seam.
     """
     version_dir = Path(version_dir)
+    params_host = serialization.to_state_dict(fetch_to_host(state.params))
+    if state_layout is not None:
+        params_host = tree_to_canonical(params_host, state_layout)
     payload = {
         "fmt": CKPT_FMT,
-        "params": serialization.to_state_dict(fetch_to_host(state.params)),
+        "params": params_host,
         "batch_stats": serialization.to_state_dict(fetch_to_host(state.batch_stats)),
         "epoch": epoch,
         "val_acc": float(val_acc),
@@ -169,11 +183,17 @@ def save_checkpoint(version_dir: str | Path, state: TrainState, epoch: int, val_
     return path
 
 
-def load_checkpoint(path: str | Path, state: TrainState) -> TrainState:
-    """Restore params/batch_stats from a best checkpoint into ``state``."""
+def load_checkpoint(path: str | Path, state: TrainState, state_layout=None) -> TrainState:
+    """Restore params/batch_stats from a best checkpoint into ``state``.
+
+    Checkpoints are canonical on disk; ``state_layout`` converts the
+    restored trunk stack to the live state's resident layout so the
+    returned state matches the installed schedule's shapes."""
     raw = serialization.msgpack_restore(Path(path).read_bytes())
     _check_ckpt_fmt(raw, state.params, path)
     params = serialization.from_state_dict(state.params, raw["params"])
+    if state_layout is not None:
+        params = tree_from_canonical(params, state_layout)
     batch_stats = serialization.from_state_dict(state.batch_stats, raw["batch_stats"])
     return state.replace(params=params, batch_stats=batch_stats)
 
@@ -379,6 +399,7 @@ def save_resume_state(
     best_acc: float,
     fault_hook: Callable[[str, Path], None] | None = None,
     meta: dict | None = None,
+    state_layout=None,
 ) -> Path:
     """Write the fully-resumable ``last.ckpt`` (capability the reference
     lacks), crash-safely:
@@ -396,8 +417,18 @@ def save_resume_state(
     (``FaultPlan.ckpt_hook``): ``"pre"`` may raise (write failure),
     ``"post"`` may corrupt the landed file (torn write).  ``meta`` merges
     into the manifest (the Trainer records the saving mesh topology for
-    elastic-restore accounting)."""
+    elastic-restore accounting).
+
+    On disk the trunk stack is CANONICAL whatever ``state_layout`` the
+    live state is resident in (the chunk view is a byte-preserving
+    reshape, so this costs a numpy view); the manifest records the
+    saving run's layout tag under ``state_layout`` so
+    ``elastic.validate_reshard`` can report cross-layout restores.  The
+    comms error-feedback residual is schedule-laid wire format, never
+    canonicalized."""
     host_state = serialization.to_state_dict(fetch_to_host(_state_dict(state)))
+    if state_layout is not None:
+        host_state = tree_to_canonical(host_state, state_layout)
     payload = {
         "fmt": CKPT_FMT,
         "state": host_state,
@@ -419,6 +450,7 @@ def save_resume_state(
             "step": int(np.asarray(host_state["step"])),
             "epoch": int(epoch),
             "best_acc": float(best_acc),
+            **({"state_layout": state_layout.tag} if state_layout is not None else {}),
             **(meta or {}),
         },
     )
@@ -432,6 +464,7 @@ def load_resume_state(
     state: TrainState,
     raw_bytes: bytes | None = None,
     info: dict | None = None,
+    state_layout=None,
 ) -> tuple[TrainState, int, float]:
     """Restore ``(state, next_epoch, best_acc)`` from a ``last.ckpt``.
 
@@ -445,7 +478,15 @@ def load_resume_state(
     wire layout (tree + shapes) — any other combination keeps the
     documented drop path (the caller resets to zeros and warns).
     ``info``, when given, gains ``comms_residual``:
-    ``"restored"`` / ``"dropped:<why>"`` / ``"absent"``."""
+    ``"restored"`` / ``"dropped:<why>"`` / ``"absent"``.
+
+    The on-disk trunk stack is canonical (see ``save_resume_state``);
+    ``state_layout`` converts it to the restoring run's resident layout
+    AFTER restore, so a chunk-resident interleaved run — or a contiguous
+    run restoring an old chunk-era checkpoint — gets schedule-shaped
+    params/momentum with no caller-side reshaping.  flax restores the
+    serialized (canonical) shapes regardless of the template's resident
+    shapes, which is exactly what lets one file serve every layout."""
     raw = serialization.msgpack_restore(
         raw_bytes if raw_bytes is not None else Path(path).read_bytes()
     )
@@ -455,6 +496,8 @@ def load_resume_state(
     saved_res = raw_state.pop("comms_residual", None)
     want_res = template.pop("comms_residual", None) is not None
     restored = serialization.from_state_dict(template, raw_state)
+    if state_layout is not None:
+        restored = tree_from_canonical(restored, state_layout)
     residual = None
     note = "absent"
     if saved_res is not None and want_res:

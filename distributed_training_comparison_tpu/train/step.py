@@ -98,6 +98,38 @@ def _donated_jit(fun, *, donate_argnums, monitor=None, name=None, **jit_kw):
     return call
 
 
+def _declare_state_layout(runner, fwd_bwd, state_layout):
+    """Bind the resident state layout a runner was built for.
+
+    The runners themselves are layout-agnostic by construction — the
+    update is elementwise and the shardings arrive via ``state_sharding``,
+    whose optimizer specs suffix-match whatever shapes the params carry —
+    but the layout is a construction-time contract (``parallel/
+    layouts.py``): the state, the shardings, and the schedule's
+    ``fwd_bwd`` must all have been built for the SAME resident layout.
+    This cross-checks the declared layout against the schedule's and tags
+    the runner for introspection (parity/bench read it back).
+    """
+    declared = getattr(fwd_bwd, "state_layout", None)
+    if (
+        state_layout is not None
+        and declared is not None
+        and getattr(declared, "tag", "contiguous")
+        != getattr(state_layout, "tag", "contiguous")
+    ):
+        raise ValueError(
+            f"runner built for state layout {state_layout.tag!r} but its "
+            f"fwd_bwd declares {declared.tag!r} — the resident layout is "
+            "fixed at construction (parallel/layouts.py); rebuild the "
+            "schedule and the runner together"
+        )
+    try:
+        runner.state_layout = state_layout if state_layout is not None else declared
+    except AttributeError:  # jitted callables may refuse new attributes
+        pass
+    return runner
+
+
 def _cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
 
@@ -358,6 +390,7 @@ def make_train_step(
     fwd_bwd=None,
     comms=None,
     monitor=None,
+    state_layout=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array], tuple[TrainState, Metrics]]:
     """Build the compiled ``(state, images_u8, labels, key) -> (state, metrics)``.
 
@@ -368,6 +401,10 @@ def make_train_step(
     ``state_sharding`` — a ``TrainState``-shaped pytree of shardings (see
     ``parallel.state_shardings``) pinning the tensor-parallel layout; when
     ``None`` the state is fully replicated (pure data parallelism).
+
+    ``state_layout`` — the resident trunk layout the state carries
+    (``parallel/layouts.py``); declarative for this layout-agnostic
+    runner, cross-checked against ``fwd_bwd``'s schedule layout.
     """
     data_shard = batch_sharding(mesh)
     accum_shard = batch_sharding(mesh, axis=1)  # micro-batch layout (a, b/a, ...)
@@ -381,13 +418,16 @@ def make_train_step(
     # No buffer donation here: this per-step path serves benchmarks and
     # tests that re-read their inputs after the call (the scanned runners
     # donate — they own the train loop's hot path; see make_epoch_runner).
-    return _observed(
-        jax.jit(
-            core,
-            in_shardings=(state_sh, data_shard, data_shard, repl),
-            out_shardings=(state_sh, repl),
+    return _declare_state_layout(
+        _observed(
+            jax.jit(
+                core,
+                in_shardings=(state_sh, data_shard, data_shard, repl),
+                out_shardings=(state_sh, repl),
+            ),
+            monitor, "train_step",
         ),
-        monitor, "train_step",
+        fwd_bwd, state_layout,
     )
 
 
@@ -411,6 +451,7 @@ def make_replay_step(
     fwd_bwd=None,
     comms=None,
     fault_injection: bool = False,
+    state_layout=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """One-step host-mode replay for the parity rail (``parity/diff.py``).
 
@@ -440,7 +481,7 @@ def make_replay_step(
         mesh, precision=precision, augment=augment, mean=mean, std=std,
         state_sharding=state_sharding, grad_accum=grad_accum,
         fwd_bwd=fwd_bwd, comms=comms, fault_injection=fault_injection,
-        donate=False,
+        donate=False, state_layout=state_layout,
     )
     benign = tuple(jnp.asarray(v) for v in BENIGN_FAULT)
 
@@ -452,7 +493,7 @@ def make_replay_step(
         state, stacked = runner(*args)
         return state, {k: v[0] for k, v in stacked.items()}
 
-    return replay
+    return _declare_state_layout(replay, fwd_bwd, state_layout)
 
 
 def make_device_replay_step(
@@ -468,6 +509,7 @@ def make_device_replay_step(
     fwd_bwd=None,
     comms=None,
     fault_injection: bool = False,
+    state_layout=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """One-step device-mode replay: ``make_device_chunk_runner`` at
     ``chunk_steps=1`` with ``donate=False`` -- the same executable-family
@@ -479,6 +521,7 @@ def make_device_replay_step(
         mean=mean, std=std, state_sharding=state_sharding,
         grad_accum=grad_accum, fwd_bwd=fwd_bwd, comms=comms,
         fault_injection=fault_injection, donate=False,
+        state_layout=state_layout,
     )
     benign = tuple(jnp.asarray(v) for v in BENIGN_FAULT)
 
@@ -490,7 +533,7 @@ def make_device_replay_step(
         state, stacked = runner(*args)
         return state, {k: v[0] for k, v in stacked.items()}
 
-    return replay
+    return _declare_state_layout(replay, fwd_bwd, state_layout)
 
 
 def _make_eval_core(mesh: Mesh, precision: str, mean, std):
@@ -621,6 +664,7 @@ def make_chunk_runner(
     fault_injection: bool = False,
     donate: bool = True,
     monitor=None,
+    state_layout=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """K loader steps as ONE compiled ``lax.scan`` dispatch (host streaming).
 
@@ -680,17 +724,23 @@ def make_chunk_runner(
         )
         in_sh = (state_sh, chunk_shard, chunk_shard, repl, repl)
     if donate:
-        return _donated_jit(
-            run,
-            donate_argnums=(0, 1, 2),
-            monitor=monitor,
-            name="chunk_runner",
-            in_shardings=in_sh,
-            out_shardings=(state_sh, repl),
+        return _declare_state_layout(
+            _donated_jit(
+                run,
+                donate_argnums=(0, 1, 2),
+                monitor=monitor,
+                name="chunk_runner",
+                in_shardings=in_sh,
+                out_shardings=(state_sh, repl),
+            ),
+            fwd_bwd, state_layout,
         )
-    return _observed(
-        jax.jit(run, in_shardings=in_sh, out_shardings=(state_sh, repl)),
-        monitor, "chunk_runner",
+    return _declare_state_layout(
+        _observed(
+            jax.jit(run, in_shardings=in_sh, out_shardings=(state_sh, repl)),
+            monitor, "chunk_runner",
+        ),
+        fwd_bwd, state_layout,
     )
 
 
@@ -710,6 +760,7 @@ def make_device_chunk_runner(
     fault_injection: bool = False,
     donate: bool = True,
     monitor=None,
+    state_layout=None,
 ) -> Callable[..., tuple[TrainState, Metrics]]:
     """``chunk_steps`` steps of a device-resident epoch as ONE scanned
     dispatch — the chunked form of ``make_epoch_runner``.
@@ -780,12 +831,18 @@ def make_device_chunk_runner(
     # would collide on one fingerprint
     obs_name = f"device_chunk_runner@k{chunk_steps}"
     if donate:
-        return _donated_jit(
-            run, donate_argnums=(0,), monitor=monitor,
-            name=obs_name, out_shardings=(state_sh, repl),
+        return _declare_state_layout(
+            _donated_jit(
+                run, donate_argnums=(0,), monitor=monitor,
+                name=obs_name, out_shardings=(state_sh, repl),
+            ),
+            fwd_bwd, state_layout,
         )
-    return _observed(
-        jax.jit(run, out_shardings=(state_sh, repl)), monitor, obs_name
+    return _declare_state_layout(
+        _observed(
+            jax.jit(run, out_shardings=(state_sh, repl)), monitor, obs_name
+        ),
+        fwd_bwd, state_layout,
     )
 
 
@@ -804,6 +861,7 @@ def make_epoch_runner(
     fault_injection: bool = False,
     donate: bool = True,
     monitor=None,
+    state_layout=None,
 ) -> Callable[[TrainState, jnp.ndarray, jnp.ndarray, jax.Array, jnp.ndarray], tuple[TrainState, Metrics]]:
     """One whole epoch as a single compiled ``lax.scan``.
 
@@ -864,10 +922,17 @@ def make_epoch_runner(
             _run(state, images, labels, key, epoch, None)
         )
     if donate:
-        return _donated_jit(
-            run, donate_argnums=(0,), monitor=monitor,
-            name="epoch_runner", out_shardings=(state_sh, repl),
+        return _declare_state_layout(
+            _donated_jit(
+                run, donate_argnums=(0,), monitor=monitor,
+                name="epoch_runner", out_shardings=(state_sh, repl),
+            ),
+            fwd_bwd, state_layout,
         )
-    return _observed(
-        jax.jit(run, out_shardings=(state_sh, repl)), monitor, "epoch_runner"
+    return _declare_state_layout(
+        _observed(
+            jax.jit(run, out_shardings=(state_sh, repl)), monitor,
+            "epoch_runner",
+        ),
+        fwd_bwd, state_layout,
     )

@@ -61,6 +61,7 @@ from ..health import HealthConfig, Watchdog, check_desync, param_fingerprint, wr
 from ..models import get_model
 from ..parallel import is_main_process, make_mesh, state_shardings
 from ..parallel import comms as comms_mod
+from ..parallel import layouts as layouts_mod
 from ..parallel.sharding import (
     fetch_to_host,
     host_local_batch_slice,
@@ -352,6 +353,11 @@ class Trainer:
         self._pipe_meta = None
         self._local_stages: list[int] = []
         self._residual_spec_fn = None  # pipeline wire: params -> (zeros, sh)
+        # the resident trunk layout the installed schedule declares
+        # (parallel/layouts.py): contiguous everywhere except resident
+        # interleaved v>1, where the TrainState carries the (v, P, K)
+        # chunk view so the per-step relayout disappears from the hot path
+        self._state_layout = layouts_mod.CONTIGUOUS
         if (style != "tensor" and mp_size > 1) or pipeline_active:
             from ..models.vit import ViT
 
@@ -437,12 +443,26 @@ class Trainer:
                         pipe=pipe_size if virtual > 1 else None,
                     )
                 )
+            # the schedule's resident trunk layout: chunked (v, P, K) for
+            # resident interleaved v>1, contiguous otherwise.  The state
+            # is re-laid ONCE below (state_from_canonical) and every
+            # reader — eval, checkpoints, parity, the planner — goes
+            # through this one seam.  --no-pipeline-resident-layout keeps
+            # the legacy per-step relayout (the bench baseline).
+            self._state_layout = layouts_mod.layout_for(
+                schedule, virtual=virtual, pipe=pipe_size,
+                pipe_axis=pipe_axis, tp_axis=tp_axis,
+                resident=bool(
+                    getattr(hparams, "pipeline_resident_layout", True)
+                ),
+            )
             # eval always runs the (forward-only) GPipe schedule; the
             # train-time backward is picked by --pipeline-schedule
             state = state.replace(
                 apply_fn=make_pipelined_apply_fn(
                     self.model, self.mesh, num_microbatches=micro,
                     pipe_axis=pipe_axis, tp_axis=tp_axis,
+                    state_layout=self._state_layout,
                 )
             )
             if schedule in ("1f1b", "interleaved"):
@@ -455,18 +475,26 @@ class Trainer:
                     self.model, self.mesh, num_microbatches=micro,
                     virtual=virtual, pipe_axis=pipe_axis, tp_axis=tp_axis,
                     grad_comms=self.grad_comms,
+                    state_layout=self._state_layout,
                 )
                 if self.train_fwd_bwd.carries_residual:
                     self._residual_spec_fn = (
-                        lambda params, _v=virtual, _pa=pipe_axis, _ta=tp_axis: (
+                        lambda params, _v=virtual, _pa=pipe_axis,
+                        _ta=tp_axis, _sl=self._state_layout: (
                             pipeline_residual_spec(
                                 params, self.mesh, virtual=_v,
                                 pipe_axis=_pa, tp_axis=_ta,
+                                state_layout=_sl,
                             )
                         )
                     )
+            # the ONE construction-time relayout that replaced the
+            # per-step one: params + mirrored momentum go resident here,
+            # and pp_state_shardings below shards the resident shapes
+            state = layouts_mod.state_from_canonical(state, self._state_layout)
             self.state_sharding = pp_state_shardings(
-                self.mesh, state, pipe_axis=pipe_axis, tp_axis=tp_axis
+                self.mesh, state, pipe_axis=pipe_axis, tp_axis=tp_axis,
+                state_layout=self._state_layout,
             )
             self._pipe_meta = {
                 **schedule_meta(schedule, pipe_size, micro, virtual),
@@ -474,6 +502,7 @@ class Trainer:
                 "tp": mp_size if tp_axis is not None else 1,
                 "data": n_data,
                 "depth": self.model.depth,
+                "state_layout": self._state_layout.tag,
             }
             # the pipe coordinates this process's devices own — the
             # (host, stage) span lanes and per-stage straggler sketches
@@ -631,6 +660,7 @@ class Trainer:
                 comms=self.comms,
                 fault_injection=self._step_faults,
                 monitor=self.compile_monitor,
+                state_layout=self._state_layout,
             )
         # whole-split scanned eval: one dispatch per validate()/test() call
         # (one executable per split shape), matching the train path's
@@ -811,7 +841,7 @@ class Trainer:
             resume_info: dict = {}
             state, self.start_epoch, self.best_acc = ckpt.load_resume_state(
                 hparams.resume, self.state, raw_bytes=resume_bytes,
-                info=resume_info,
+                info=resume_info, state_layout=self._state_layout,
             )
             resume_bytes = None  # drop the (possibly GB-sized) buffer now
             res_note = resume_info.get("comms_residual", "absent")
@@ -869,6 +899,7 @@ class Trainer:
                     if self._pipe_meta is not None
                     else None
                 ),
+                state_layout=self._state_layout.tag,
             )
             if self._reshard.get("shard_optim_changed"):
                 # checkpoints are host pytrees, so crossing --shard-optim
@@ -879,6 +910,18 @@ class Trainer:
                     f"{self._reshard['saved_shard_optim']} → restoring "
                     f"with shard_optim={self.shard_optim} (optimizer "
                     "state re-laid out; values unchanged)"
+                )
+            if self._reshard.get("state_layout_changed"):
+                # the state-layout half: the canonical-on-disk format makes
+                # crossing a schedule/layout change (v change, pp resize,
+                # chunked↔contiguous) a restore-time re-layout through the
+                # seam — bitwise-neutral reshapes, values unchanged
+                self.logger.info(
+                    "state-layout reshard: checkpoint saved resident as "
+                    f"{self._reshard['saved_state_layout']} → restoring "
+                    f"resident as {self._reshard['state_layout']} (trunk "
+                    "stack re-laid through the canonical view; values "
+                    "unchanged)"
                 )
             elastic_msg = elastic.describe_restore(manifest, self.mesh)
             if elastic_msg:
@@ -965,6 +1008,7 @@ class Trainer:
             resharded=bool(self._reshard and self._reshard["changed"]),
             shard_optim=self.shard_optim,
             grad_comms=self.grad_comms,
+            state_layout=self._state_layout.tag,
             resume_step_offset=self._resume_step_offset,
             init_s=round(self._init_secs, 4),
         )
@@ -1222,6 +1266,11 @@ class Trainer:
         # records the delta for the log
         meta["shard_optim"] = self.shard_optim
         meta["grad_comms"] = self.grad_comms
+        # the resident trunk layout the SAVING run carried — the payload
+        # itself is always canonical on disk (parallel/layouts.py), so
+        # this is identity metadata: validate_reshard compares it against
+        # the restoring run's layout and reports state_layout_changed
+        meta["state_layout"] = self._state_layout.tag
         # does this checkpoint carry the error-feedback residual?  A
         # restore that cannot use it (flag off, fp32 wire, or a changed
         # wire layout) reads this to say WHY it dropped it.
@@ -1343,6 +1392,7 @@ class Trainer:
                 comms=self.comms,
                 fault_injection=self._step_faults,
                 monitor=self.compile_monitor,
+                state_layout=self._state_layout,
             )
             self._device_runners[take] = runner
         return runner
@@ -1595,7 +1645,10 @@ class Trainer:
                 if want_best:
                     self.ckpt_writer.submit(
                         lambda s=state_ref, e=epoch, b=self.best_acc: (
-                            ckpt.save_checkpoint(vdir, s, e, b)
+                            ckpt.save_checkpoint(
+                                vdir, s, e, b,
+                                state_layout=self._state_layout,
+                            )
                         ),
                         key="best",
                     )
@@ -1612,6 +1665,7 @@ class Trainer:
                                 vdir, s, e, b,
                                 fault_hook=h,
                                 meta=self._ckpt_meta(),
+                                state_layout=self._state_layout,
                             )
                         ),
                         key="last",
@@ -1970,7 +2024,8 @@ class Trainer:
             if self.is_main:
                 path, data = hit
                 state0, next_epoch, best = ckpt.load_resume_state(
-                    path, self.state, raw_bytes=data
+                    path, self.state, raw_bytes=data,
+                    state_layout=self._state_layout,
                 )
                 host = jax.tree_util.tree_map(
                     np.asarray, _no_residual(ckpt._state_dict(state0))
@@ -1995,7 +2050,8 @@ class Trainer:
                 return None
             path, data = hit
             state, next_epoch, best = ckpt.load_resume_state(
-                path, self.state, raw_bytes=data
+                path, self.state, raw_bytes=data,
+                state_layout=self._state_layout,
             )
         state = self._reset_comms_residual(state)
         self.state = place_tree(state, self.state_sharding)
@@ -2611,6 +2667,7 @@ class Trainer:
                         ckpt.save_resume_state(
                             self.version_dir, s, e, b,
                             meta=self._ckpt_meta(),
+                            state_layout=self._state_layout,
                         )
                     ),
                     key="last",
@@ -2658,6 +2715,7 @@ class Trainer:
                                 "epoch_in_progress": e,
                                 "epoch_steps_done": n,
                             },
+                            state_layout=self._state_layout,
                         )
                     ),
                     key="last",
@@ -2840,6 +2898,7 @@ class Trainer:
             fwd_bwd=self.train_fwd_bwd,
             comms=self.comms,
             fault_injection=self._step_faults,
+            state_layout=self._state_layout,
         )
         if cap.mode == "host":
             rp = make_replay_step(self.mesh, **common)
@@ -2878,8 +2937,15 @@ class Trainer:
             # pipeline schedules and sequence rings are layout transforms
             # around that same math, which is exactly the claim the diff
             # checks
+            # the eager rail always speaks the canonical (contiguous)
+            # trunk — a chunk-resident capture canonicalizes its initial
+            # snapshot here and its replayed states through the
+            # canonicalize_state hook below (bitwise-neutral reshapes)
             eager_state = parity_mod.eager_state_like(
-                cap.initial, self.model.apply
+                layouts_mod.state_to_canonical(
+                    cap.initial, self._state_layout
+                ),
+                self.model.apply,
             )
 
             def eager_step(st, rec):
@@ -2896,6 +2962,7 @@ class Trainer:
             ),
             "schedule": getattr(self.hparams, "pipeline_schedule", None)
             or "none",
+            "state_layout": self._state_layout.tag,
         }
         report = parity_mod.run_parity_check(
             cap,
@@ -2905,6 +2972,9 @@ class Trainer:
             eager_state=eager_state,
             eager_unsupported_reason=reason,
             layout=layout,
+            canonicalize_state=lambda s: layouts_mod.state_to_canonical(
+                s, self._state_layout
+            ),
         )
         self.bus.emit("parity", **report)
         div = report["replay_divergence"] or report["reference_divergence"]
@@ -3260,7 +3330,9 @@ class Trainer:
             )
             if best is not None:
                 self.logger.info(f"Loading best checkpoint: {best.name}")
-                self.state = ckpt.load_checkpoint(best, self.state)
+                self.state = ckpt.load_checkpoint(
+                    best, self.state, state_layout=self._state_layout
+                )
             if jax.process_count() > 1:
                 # Only process 0 has the checkpoint on disk; broadcast its
                 # params/BN stats so every host evaluates the same model

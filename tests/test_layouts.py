@@ -1,0 +1,523 @@
+"""Schedule-native state layouts (parallel/layouts.py): the chunk view is
+an exact, bitwise-neutral reshape of the canonical trunk stack; checkpoints
+stay canonical on disk whatever resident layout the schedule carries; and
+restoring across a layout change (v change, pp resize, chunked<->contiguous)
+round-trips bit-identically through the reshard seam.
+
+The reference trains a contiguous stack only (no interleaving at all); the
+contract here is that the resident chunk view is invisible everywhere
+values are compared — fingerprints, checkpoints, manifests, report gates.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_training_comparison_tpu.health.desync import (
+    fingerprint_leaves,
+)
+from distributed_training_comparison_tpu.models import ViT
+from distributed_training_comparison_tpu.parallel import make_mesh
+from distributed_training_comparison_tpu.parallel.layouts import (
+    CONTIGUOUS,
+    ChunkedLayout,
+    StateLayout,
+    layout_for,
+    layout_tag_for,
+    state_from_canonical,
+    state_to_canonical,
+    tree_from_canonical,
+    tree_to_canonical,
+)
+from distributed_training_comparison_tpu.resilience.elastic import (
+    validate_reshard,
+)
+from distributed_training_comparison_tpu.train import checkpoint as ckpt
+from distributed_training_comparison_tpu.train.state import create_train_state
+
+MODEL_KW = dict(depth=8, dim=32, heads=2, patch=8)
+
+
+def _small_state(seed=0):
+    model = ViT(**MODEL_KW)
+    return create_train_state(
+        model, jax.random.key(seed), optax.sgd(0.1, momentum=0.9)
+    )
+
+
+# ------------------------------------------------------------- unit: leaves
+
+
+def test_chunked_leaf_roundtrip_bitwise():
+    leaf = np.arange(8 * 5 * 3, dtype=np.float32).reshape(8, 5, 3)
+    lay = ChunkedLayout(virtual=2, pipe=2, pipe_axis="model")
+    resident = lay.leaf_from_canonical(leaf)
+    assert resident.shape == (2, 2, 2, 5, 3)
+    back = lay.leaf_to_canonical(resident)
+    assert back.shape == leaf.shape
+    assert np.array_equal(np.asarray(back), leaf)
+
+
+def test_chunked_leaf_placement_matches_schedule():
+    # chunk c = i*P + s lives at [i, s]: resident[i, s, k] must be the
+    # canonical layer i*(P*K) + s*K + k — the interleaved runner's own
+    # indexing (parallel/pipeline.py), as one exact C-order reshape
+    v, p, k = 2, 2, 2
+    depth = v * p * k
+    leaf = np.arange(depth, dtype=np.float32).reshape(depth, 1)
+    lay = ChunkedLayout(virtual=v, pipe=p, pipe_axis="model")
+    resident = np.asarray(lay.leaf_from_canonical(leaf))
+    for i in range(v):
+        for s in range(p):
+            for kk in range(k):
+                assert resident[i, s, kk, 0] == i * (p * k) + s * k + kk
+
+
+def test_chunked_leaf_divisibility_refused():
+    lay = ChunkedLayout(virtual=2, pipe=3, pipe_axis="model")
+    with pytest.raises(ValueError):
+        lay.leaf_from_canonical(np.zeros((8, 4), np.float32))
+
+
+def test_leaf_canonicalized_detects_resident_shape():
+    lay = ChunkedLayout(virtual=2, pipe=2, pipe_axis="model")
+    canonical = np.arange(16, dtype=np.float32).reshape(8, 2)
+    resident = lay.leaf_from_canonical(canonical)
+    # resident leaf -> canonical; an already-canonical leaf passes through
+    assert np.array_equal(np.asarray(lay.leaf_canonicalized(resident)),
+                          canonical)
+    assert np.array_equal(np.asarray(lay.leaf_canonicalized(canonical)),
+                          canonical)
+
+
+def test_contiguous_layout_is_identity():
+    tree = {"blocks": {"w": np.ones((8, 3), np.float32)}}
+    assert tree_from_canonical(tree, CONTIGUOUS) is tree
+    assert tree_to_canonical(tree, CONTIGUOUS) is tree
+    assert CONTIGUOUS.tag == "contiguous"
+
+
+# --------------------------------------------------------- unit: selection
+
+
+def test_layout_for_selects_chunked_only_for_interleaved_virtual():
+    lay = layout_for("interleaved", virtual=2, pipe=4)
+    assert isinstance(lay, ChunkedLayout)
+    assert lay.tag == "chunked:v2:p4"
+    for schedule, virtual, pipe in [
+        ("interleaved", 1, 4),   # v=1: the chunk view IS the stack
+        ("interleaved", 2, 1),   # no pipe axis
+        ("gpipe", 2, 4),
+        ("1f1b", 1, 4),
+        (None, 1, 1),
+    ]:
+        lay = layout_for(schedule, virtual=virtual, pipe=pipe)
+        assert lay.kind == "contiguous" and lay.tag == "contiguous"
+    # the legacy escape hatch (--no-pipeline-resident-layout)
+    assert layout_for(
+        "interleaved", virtual=2, pipe=4, resident=False
+    ).kind == "contiguous"
+
+
+def test_layout_tag_for_strings():
+    assert layout_tag_for("interleaved", virtual=2, pipe=4) == "chunked:v2:p4"
+    assert layout_tag_for("interleaved", virtual=2, pipe=4,
+                          resident=False) == "contiguous"
+    assert layout_tag_for("gpipe", virtual=1, pipe=4) == "contiguous"
+    assert layout_tag_for(None) == "contiguous"
+
+
+def test_chunked_layout_refuses_degenerate_degrees():
+    with pytest.raises(ValueError):
+        ChunkedLayout(virtual=1, pipe=4, pipe_axis="model")
+    with pytest.raises(ValueError):
+        ChunkedLayout(virtual=2, pipe=1, pipe_axis="model")
+
+
+# ------------------------------------------------------------- unit: trees
+
+
+def test_tree_roundtrip_skips_comms_residual():
+    lay = ChunkedLayout(virtual=2, pipe=2, pipe_axis="model")
+    tree = {
+        "params": {
+            "blocks": {"w": np.arange(16, dtype=np.float32).reshape(8, 2)}
+        },
+        "comms_residual": {"blocks": {"w": np.zeros((8, 2), np.float32)}},
+    }
+    resident = tree_from_canonical(tree, lay)
+    # blocks under params re-lay; the schedule-laid EF residual is left alone
+    assert resident["params"]["blocks"]["w"].shape == (2, 2, 2, 2)
+    assert resident["comms_residual"]["blocks"]["w"].shape == (8, 2)
+    back = tree_to_canonical(resident, lay)
+    assert np.array_equal(np.asarray(back["params"]["blocks"]["w"]),
+                          np.asarray(tree["params"]["blocks"]["w"]))
+
+
+def test_state_roundtrip_covers_params_and_momentum():
+    state = _small_state()
+    lay = ChunkedLayout(virtual=2, pipe=2, pipe_axis="model")
+    paths0, fp0 = fingerprint_leaves(state.params)
+    resident = state_from_canonical(state, lay)
+    # every trunk leaf (params AND sgd momentum) carries the chunk view
+    for tree in (resident.params["blocks"],):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            assert leaf.shape[:2] == (2, 2)
+    momentum = resident.opt_state[0].trace["blocks"]
+    for leaf in jax.tree_util.tree_leaves(momentum):
+        assert leaf.shape[:2] == (2, 2)
+    back = state_to_canonical(resident, lay)
+    paths1, fp1 = fingerprint_leaves(back.params)
+    assert paths0 == paths1
+    assert np.array_equal(np.asarray(fp0), np.asarray(fp1))
+
+
+def test_chunked_specs_shard_stage_axis():
+    state = _small_state()
+    lay = ChunkedLayout(virtual=2, pipe=2, pipe_axis="model")
+    resident_blocks = tree_from_canonical(
+        {"blocks": state.params["blocks"]}, lay
+    )["blocks"]
+    specs = lay.specs(resident_blocks)
+    for spec in jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+    ):
+        # axis 0 (virtual) replicated, axis 1 (stage) on the pipe axis
+        assert spec[0] is None
+        assert spec[1] == "model"
+
+
+# ------------------------------------------- validate_reshard layout matrix
+
+
+def _manifest(mesh_shape, *, state_layout=None, **extra):
+    man = {
+        "mesh": dict(mesh_shape),
+        "devices": jax.device_count(),
+        **extra,
+    }
+    if state_layout is not None:
+        man["state_layout"] = state_layout
+    return man
+
+
+@pytest.mark.parametrize(
+    "saved,now,want_changed",
+    [
+        ("chunked:v2:p4", "chunked:v2:p4", False),   # same layout
+        ("chunked:v2:p4", "chunked:v4:p2", True),    # v change + pp resize
+        ("chunked:v2:p4", "contiguous", True),       # chunked -> contiguous
+        ("contiguous", "chunked:v2:p4", True),       # contiguous -> chunked
+        (None, "chunked:v2:p4", False),              # pre-layout manifest
+    ],
+)
+def test_validate_reshard_reports_layout_change(saved, now, want_changed):
+    mesh = make_mesh(8, 1, 4)
+    report = validate_reshard(
+        _manifest(mesh.shape, state_layout=saved),
+        mesh,
+        batch_size=64,
+        pipeline={"depth": 8, "pipe": 4, "virtual": 2, "microbatches": 4},
+        state_layout=None if now == "contiguous" else now,
+    )
+    assert report["saved_state_layout"] == saved
+    assert report["state_layout"] == now
+    assert report["state_layout_changed"] is want_changed
+    # a layout change alone is never a topology change
+    assert report["changed"] is False
+
+
+def test_validate_reshard_layout_change_with_pp_resize():
+    # shrink pipe 4 -> 2: mesh changed AND the resident layout changed;
+    # both reported, neither refused (depth 8 % (2*2) == 0)
+    mesh = make_mesh(8, 1, 2)
+    report = validate_reshard(
+        _manifest({"data": 2, "model": 1, "pipe": 4},
+                  state_layout="chunked:v2:p4"),
+        mesh,
+        batch_size=64,
+        pipeline={"depth": 8, "pipe": 2, "virtual": 2, "microbatches": 4},
+        state_layout="chunked:v2:p2",
+    )
+    assert report["changed"] is True
+    assert report["pipe_changed"] is True
+    assert report["state_layout_changed"] is True
+
+
+# --------------------------------------- checkpoint: canonical on disk
+
+
+def _save_and_manifest(tmp_path, state, layout):
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    path = ckpt.save_resume_state(
+        tmp_path, state, epoch=1, best_acc=0.5, state_layout=layout
+    )
+    from distributed_training_comparison_tpu.resilience import read_manifest
+
+    return path, read_manifest(path)
+
+
+def test_resume_state_canonical_on_disk_roundtrip(tmp_path):
+    """Save from a chunked-resident state, restore into every layout:
+    the canonical fingerprints agree bitwise in all directions."""
+    canonical = _small_state()
+    paths0, fp0 = fingerprint_leaves(
+        jax.device_get(
+            {"params": canonical.params, "opt": canonical.opt_state}
+        )
+    )
+    lay = ChunkedLayout(virtual=2, pipe=4, pipe_axis="model")
+    resident = state_from_canonical(canonical, lay)
+    path, manifest = _save_and_manifest(tmp_path / "a", resident, lay)
+    assert manifest["state_layout"] == "chunked:v2:p4"
+
+    # restore contiguous (template = fresh canonical state)
+    restored, epoch, acc = ckpt.load_resume_state(
+        path, _small_state(seed=1), state_layout=None
+    )
+    assert (epoch, acc) == (2, 0.5)
+    _, fp1 = fingerprint_leaves(
+        jax.device_get({"params": restored.params, "opt": restored.opt_state})
+    )
+    assert np.array_equal(np.asarray(fp0), np.asarray(fp1))
+
+    # restore into a DIFFERENT chunk view (v=4, p=2): still bitwise once
+    # read back through the canonical view
+    lay2 = ChunkedLayout(virtual=4, pipe=2, pipe_axis="model")
+    template2 = state_from_canonical(_small_state(seed=2), lay2)
+    restored2, _, _ = ckpt.load_resume_state(path, template2, state_layout=lay2)
+    for leaf in jax.tree_util.tree_leaves(restored2.params["blocks"]):
+        assert leaf.shape[:2] == (4, 2)
+    canonical2 = state_to_canonical(restored2, lay2)
+    _, fp2 = fingerprint_leaves(
+        jax.device_get(
+            {"params": canonical2.params, "opt": canonical2.opt_state}
+        )
+    )
+    assert np.array_equal(np.asarray(fp0), np.asarray(fp2))
+
+
+def test_resume_state_contiguous_save_restores_into_chunked(tmp_path):
+    """The inverse rollback direction: a contiguous checkpoint (old run)
+    restores into a chunked-resident attempt bit-identically."""
+    canonical = _small_state()
+    _, fp0 = fingerprint_leaves(jax.device_get(canonical.params))
+    path, manifest = _save_and_manifest(tmp_path / "b", canonical, CONTIGUOUS)
+    assert manifest["state_layout"] == "contiguous"
+    lay = ChunkedLayout(virtual=2, pipe=2, pipe_axis="model")
+    template = state_from_canonical(_small_state(seed=3), lay)
+    restored, _, _ = ckpt.load_resume_state(path, template, state_layout=lay)
+    for leaf in jax.tree_util.tree_leaves(restored.params["blocks"]):
+        assert leaf.shape[:2] == (2, 2)
+    _, fp1 = fingerprint_leaves(
+        jax.device_get(state_to_canonical(restored, lay).params)
+    )
+    assert np.array_equal(np.asarray(fp0), np.asarray(fp1))
+
+
+def test_save_checkpoint_eval_export_is_canonical(tmp_path):
+    """The eval/export checkpoint (best.ckpt family) canonicalizes too:
+    a chunked-resident trainer writes the same bytes a contiguous one
+    would."""
+    canonical = _small_state()
+    lay = ChunkedLayout(virtual=2, pipe=4, pipe_axis="model")
+    resident = state_from_canonical(canonical, lay)
+    d1 = tmp_path / "from-resident"
+    d2 = tmp_path / "from-canonical"
+    d1.mkdir()
+    d2.mkdir()
+    p1 = ckpt.save_checkpoint(d1, resident, 0, 0.1, state_layout=lay)
+    p2 = ckpt.save_checkpoint(d2, canonical, 0, 0.1, state_layout=CONTIGUOUS)
+    assert p1.read_bytes() == p2.read_bytes()
+
+
+# ----------------------------------------------- run_report --plan gate
+
+
+def _write_events(path, events):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return path
+
+
+def _plan_event(layout, *, attempt=0, t_wall=10.0):
+    return {
+        "kind": "plan", "t_wall": t_wall, "process_index": 0,
+        "attempt": attempt,
+        "payload": {
+            "chosen": {"key": "k", **layout},
+            "layout": layout,
+            "installed": True,
+            "reason": "construction",
+            "devices": 8,
+            "batch_size": 32,
+            "candidates": [
+                {"key": "k", "predicted_step_s": 0.01,
+                 "predicted_hbm_bytes": 1e6, **layout}
+            ],
+            "fit": {"source": "default"},
+            "attempt": attempt,
+        },
+    }
+
+
+def _run_start_event(mesh, *, attempt=0, t_wall=11.0, state_layout=None):
+    payload = {
+        "mesh": mesh, "world_size": 1, "batch_size": 32,
+        "shard_optim": False, "grad_comms": "fp32",
+    }
+    if state_layout is not None:
+        payload["state_layout"] = state_layout
+    return {
+        "kind": "run_start", "t_wall": t_wall, "process_index": 0,
+        "attempt": attempt, "payload": payload,
+    }
+
+
+LAYOUT_PP = {
+    "data": 2, "model": 1, "pipe": 4, "shard_optim": False,
+    "grad_comms": "fp32", "state_layout": "chunked:v2:p4",
+}
+MESH_PP = {"data": 2, "model": 1, "pipe": 4}
+
+
+def test_plan_report_gates_state_layout(tmp_path, capsys):
+    from tools import run_report
+
+    _write_events(
+        tmp_path / "events.jsonl",
+        [
+            _plan_event(LAYOUT_PP),
+            _run_start_event(MESH_PP, state_layout="chunked:v2:p4"),
+        ],
+    )
+    assert run_report.plan_report(tmp_path) == 0
+    capsys.readouterr()
+    # the run silently fell back to the legacy per-step relayout: caught
+    _write_events(
+        tmp_path / "events.jsonl",
+        [
+            _plan_event(LAYOUT_PP),
+            _run_start_event(MESH_PP, state_layout="contiguous"),
+        ],
+    )
+    assert run_report.plan_report(tmp_path) == 1
+    assert "state_layout" in capsys.readouterr().out
+
+
+def test_plan_report_manifest_state_layout_gate(tmp_path, capsys):
+    from distributed_training_comparison_tpu.resilience.ckpt_io import (
+        write_manifest,
+    )
+    from tools import run_report
+
+    layout = dict(LAYOUT_PP)
+    _write_events(
+        tmp_path / "version-0" / "events.jsonl",
+        [
+            _plan_event(layout),
+            _run_start_event(MESH_PP, state_layout="chunked:v2:p4"),
+        ],
+    )
+    last = tmp_path / "version-0" / "last.ckpt"
+    last.write_bytes(b"payload")
+    # manifest agrees -> green
+    write_manifest(last, b"payload",
+                   {"attempt": 0, "state_layout": "chunked:v2:p4"})
+    assert run_report.plan_report(tmp_path) == 0
+    capsys.readouterr()
+    # manifest written under a DIFFERENT layout than the attempt ran -> red
+    write_manifest(last, b"payload",
+                   {"attempt": 0, "state_layout": "contiguous"})
+    assert run_report.plan_report(tmp_path) == 1
+    assert "MANIFEST MISMATCH" in capsys.readouterr().out
+
+
+# ----------------------------------------------------- trainer-level (slow)
+
+
+@pytest.mark.slow
+def test_trainer_chunked_resume_to_contiguous(tmp_path):
+    """Train interleaved v=2 (chunked-resident trunk), checkpoint, resume
+    under 1f1b (contiguous): the inverse direction of the schedule-change
+    test in test_pipeline.py — the canonical-on-disk contract makes the
+    chunk view invisible to the restoring run."""
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.resilience import read_manifest
+    from distributed_training_comparison_tpu.train import Trainer
+
+    common = [
+        "--synthetic-data", "--limit-examples", "256",
+        "--batch-size", "64", "--epoch", "2", "--lr", "0.01",
+        "--no-progress", "--save-last-min-secs", "0",
+        "--pipeline-parallel", "4", "--pipeline-microbatches", "4",
+        "--ckpt-path", str(tmp_path / "layout-change"),
+    ]
+    hp = load_config(
+        "tpu",
+        argv=common + [
+            "--pipeline-schedule", "interleaved",
+            "--pipeline-virtual-stages", "2", "--epoch", "1",
+        ],
+    )
+    t = Trainer(hp, model=ViT(**MODEL_KW))
+    assert t._state_layout.tag == "chunked:v2:p4"
+    t.fit()
+    vdir = t.version_dir
+    t.close()
+    last = vdir / "last.ckpt"
+    manifest = read_manifest(last)
+    assert manifest["state_layout"] == "chunked:v2:p4"
+    hp2 = load_config(
+        "tpu",
+        argv=common + [
+            "--pipeline-schedule", "1f1b", "--resume", str(last),
+        ],
+    )
+    t2 = Trainer(hp2, model=ViT(**MODEL_KW))
+    try:
+        assert t2._state_layout.tag == "contiguous"
+        assert t2.start_epoch == 1
+        losses, _ = t2._train_epoch_device(1)
+        assert np.isfinite(losses).all()
+    finally:
+        t2.close()
+
+
+@pytest.mark.slow
+def test_trainer_legacy_relayout_flag_matches_resident(tmp_path):
+    """--no-pipeline-resident-layout keeps the per-step relayout path
+    alive (the bench baseline) and trains to the same loss trajectory."""
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.train import Trainer
+
+    def run(extra, tag):
+        hp = load_config(
+            "tpu",
+            argv=[
+                "--synthetic-data", "--limit-examples", "128",
+                "--batch-size", "64", "--epoch", "1", "--lr", "0.01",
+                "--no-progress", "--seed", "7",
+                "--pipeline-parallel", "4",
+                "--pipeline-schedule", "interleaved",
+                "--pipeline-virtual-stages", "2",
+                "--pipeline-microbatches", "4",
+                "--ckpt-path", str(tmp_path / tag), *extra,
+            ],
+        )
+        t = Trainer(hp, model=ViT(**MODEL_KW))
+        try:
+            losses, _ = t._train_epoch_device(0)
+            return t._state_layout.tag, np.asarray(losses)
+        finally:
+            t.close()
+
+    tag_res, loss_res = run([], "resident")
+    tag_leg, loss_leg = run(["--no-pipeline-resident-layout"], "legacy")
+    assert tag_res == "chunked:v2:p4"
+    assert tag_leg == "contiguous"
+    np.testing.assert_allclose(loss_res, loss_leg, rtol=1e-4, atol=1e-5)

@@ -468,6 +468,9 @@ def test_trainer_all_schedules_params_allclose(tmp_path):
     """Final params of gpipe, 1f1b and interleaved all land on the
     unpipelined same-seed baseline (the acceptance criterion's parity
     contract), through the real Trainer."""
+    from distributed_training_comparison_tpu.parallel.layouts import (
+        tree_to_canonical,
+    )
     from distributed_training_comparison_tpu.parallel.sharding import (
         fetch_to_host,
     )
@@ -484,7 +487,12 @@ def test_trainer_all_schedules_params_allclose(tmp_path):
         )
         t = Trainer(hp, model=ViT(**MODEL_KW))
         t._train_epoch_device(0)
-        params = fetch_to_host(t.state.params)
+        # read through the layout seam: the interleaved run carries the
+        # trunk RESIDENT in its (v, P, K) chunk view, so cross-schedule
+        # comparison happens in the canonical (contiguous) layout
+        params = tree_to_canonical(
+            fetch_to_host(t.state.params), t._state_layout
+        )
         t.close()
         return params
 

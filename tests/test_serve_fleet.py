@@ -640,8 +640,10 @@ def _serve_compile_ev(bucket, flops):
 
 def test_plan_serve_sizes_replicas_and_trims_ladder():
     events = [_serve_compile_ev(8, 8e9), _serve_compile_ev(1, 1e9)]
+    # no p99 target anywhere → the legacy utilization ceiling, labeled
+    # with the autoscaler's own fallback name
     plan = plan_serve(events, buckets=(1, 8), rate_rps=500.0)
-    assert plan["replicas"] >= 1 and plan["sized_by"] == "ledger"
+    assert plan["replicas"] >= 1 and plan["sized_by"] == "utilization"
     assert set(plan["per_bucket"]) == {"1", "8"}
     assert plan["per_replica_capacity_rps"] > 0
     # a deadline no bucket's service time fits keeps the smallest bucket
@@ -655,6 +657,20 @@ def test_plan_serve_sizes_replicas_and_trims_ladder():
     # never from a deadline-trimmed-out bucket's throughput
     assert tight["best_bucket"] in tight["buckets"]
     assert tight["replicas"] >= plan["replicas"]
+    # a class deadline is a p99 budget: initial sizing prices the same
+    # Sakasegawa G/G/m tail the live autoscaler fits
+    assert tight["sized_by"] == "ggm"
+    assert tight["tail"]["targets_ms"]
+    # an explicit scale target drives the same path without classes (an
+    # unsaturated rate, so the G/G/m prediction is finite)
+    targeted = plan_serve(
+        events, buckets=(1, 8), rate_rps=50.0,
+        scale_targets={"*": 10.0},  # generous 10 s p99 → small fleet
+    )
+    assert targeted["sized_by"] == "ggm"
+    assert 1 <= targeted["replicas"] <= 8
+    assert targeted["tail"]["predicted_p99_ms"] is not None
+    assert targeted["tail"]["predicted_p99_ms"] <= 10_000.0
     # no serve ledger at all: one replica, honestly labeled
     empty = plan_serve([], buckets=(1, 8), rate_rps=500.0)
     assert empty["replicas"] == 1

@@ -310,6 +310,7 @@ def summarize(events: list[dict]) -> dict:
             "preempt": None, "goodput": None, "writer": None,
             "t_first": None, "t_last": None, "processes": set(),
             "metrics_events": 0, "metrics": {}, "heartbeats": 0,
+            "state_layout": None,
         }
     )
     run_ids: set[str] = set()
@@ -342,7 +343,10 @@ def summarize(events: list[dict]) -> dict:
             # 2-host attempt doesn't report doubled epochs/rollbacks
             continue
         p = _payload(ev)
-        if kind == "epoch_end":
+        if kind == "run_start":
+            # the resident layout the attempt's trunk stack actually carried
+            a["state_layout"] = p.get("state_layout") or "contiguous"
+        elif kind == "epoch_end":
             a["epochs"] += 1
         elif kind == "rollback":
             a["rollbacks"] += 1
@@ -474,6 +478,18 @@ def format_summary(name: str, s: dict) -> str:
             f" {100 * float(writer.get('busy_frac', 0.0)):>6.1f}%"
             f" {writer.get('queue_depth', 0):>4}"
             f" {h2d:>8.2f}s"
+        )
+    layouts = {
+        idx: a["state_layout"]
+        for idx, a in s["attempts"].items()
+        if a.get("state_layout")
+    }
+    if layouts:
+        lines.append(
+            "  state layout: "
+            + ", ".join(
+                f"attempt {idx}: {tag}" for idx, tag in layouts.items()
+            )
         )
     for idx, a in s["attempts"].items():
         for cause in a["rollback_causes"]:
@@ -1204,6 +1220,7 @@ def _plan_layout_of_run_start(p: dict) -> dict:
         "pipe": int(mesh.get("pipe", 1) or 1),
         "shard_optim": bool(p.get("shard_optim", False)),
         "grad_comms": str(p.get("grad_comms", "fp32") or "fp32"),
+        "state_layout": str(p.get("state_layout") or "contiguous"),
     }
 
 
@@ -1345,6 +1362,39 @@ def plan_report(path: str | Path, out=print) -> int:
                     f"{k}: planned {a!r} ran {b!r}"
                     for k, (a, b) in sorted(diffs.items())
                 )
+            )
+    # the manifest gate: every resumable checkpoint's recorded state_layout
+    # must be the layout its writing attempt's run_start declared.  A
+    # disagreement means the resident-layout seam was bypassed somewhere
+    # between construction and save — the checkpoint would restore through
+    # the wrong canonicalization on the next attempt.
+    layout_by_attempt = {
+        (rs.get("run_id"), int(rs.get("attempt", 0) or 0)):
+            _plan_layout_of_run_start(_payload(rs))["state_layout"]
+        for rs in run_starts
+    }
+    from distributed_training_comparison_tpu.resilience.ckpt_io import (
+        read_manifest,
+    )
+    root = Path(path)
+    ckpts = sorted(root.glob("version-*/last.ckpt")) + sorted(
+        root.glob("version-*/prev-last.ckpt")
+    )
+    for ck in ckpts:
+        man = read_manifest(ck) or {}
+        saved = man.get("state_layout")
+        if saved is None:
+            continue  # pre-layout checkpoint: nothing to gate
+        key = (man.get("run_id"), int(man.get("attempt", 0) or 0))
+        ran = layout_by_attempt.get(key)
+        if ran is None:
+            continue  # checkpoint from a run this stream never saw
+        if str(saved) != ran:
+            rc = 1
+            out(
+                f"    MANIFEST MISMATCH: {ck.parent.name}/{ck.name} saved "
+                f"state_layout {saved!r} but attempt {key[1]}'s run_start "
+                f"ran {ran!r}"
             )
     if rc:
         out("an installed plan was silently ignored (layout mismatch)")
