@@ -1448,6 +1448,385 @@ def events_check_rc(ckpt_root: str, require_kinds=()) -> int:
     return subprocess.run(cmd).returncode
 
 
+def bench_trace(out_path: str = "BENCH_TRACE.json") -> dict:
+    """Request tracing's scoreboard (``--trace``): what the rail costs
+    on the hot path and what it buys on the process fleet.
+
+    Four legs, one committed JSON capture:
+
+    1. **hotpath** — the tracer's per-request work in isolation (mint +
+       enqueue + batch header + finish), batches of 8, at sampling 0
+       (context only, nothing kept) and 1.0 (every span tree serialized
+       to a real event file).  The sampling-0 number is the tax every
+       healthy request pays and gates the 25 µs/request budget; the 1.0
+       number is the ceiling nobody runs at.
+    2. **fleet_tail** — sampling 0 on a real 1-process fleet: probe the
+       warm latency, then breach half of it under load.  Every breached
+       or queue-expired request must come back with a kept trace, and
+       ``run_report --trace`` must reconstruct it (device span included,
+       retro-flushed from the worker ring) with exit 0.
+    3. **fleet_full** — sampling 1.0 with the live autoscaler attached:
+       every ``serve_scale`` decision carries the Sakasegawa-modeled
+       wait NEXT TO the trace-measured one; the capture records both so
+       the model's drift is a number, not a vibe.
+    4. **kill_requeue** — SIGKILL one of two workers mid-backlog at
+       sampling 0: the rescued request keeps ONE trace spanning both
+       replicas with the failed attempt annotated ``requeued``.
+
+    Every fleet leg self-validates via ``run_report --check
+    --require-kind trace`` over the files it leaves behind.
+    """
+    import os
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+
+    from distributed_training_comparison_tpu import obs
+    from distributed_training_comparison_tpu.config import load_config
+    from distributed_training_comparison_tpu.serve import (
+        ServeRouter,
+        open_loop,
+        request_pool,
+    )
+    from distributed_training_comparison_tpu.serve.batcher import (
+        DeadlineExceeded,
+        ServeFuture,
+    )
+    from distributed_training_comparison_tpu.serve.fleet import (
+        Autoscaler,
+        parse_scale_targets,
+        worker_hparams_dict,
+    )
+
+    platform = jax.devices()[0].platform
+    repo = os.path.dirname(os.path.abspath(__file__))
+    model_name, image_size = "resnet18", 16
+    buckets = (1, 4)
+    budget_us = 25.0
+
+    root = tempfile.mkdtemp(prefix="trace-bench-")
+    aot_dir = os.path.join(root, "serve-aot")
+    legs: dict = {}
+
+    def leg(key, fn):
+        try:
+            legs[key] = fn()
+        except Exception as e:
+            legs[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        emit_progress(key, legs[key])
+        return legs[key]
+
+    def leg_setup(name, sample):
+        leg_root = os.path.join(root, name)
+        os.makedirs(leg_root, exist_ok=True)
+        bus = obs.configure(run_id=obs.new_run_id())
+        bus.bind_dir(leg_root)
+        hp = load_config("single", argv=[
+            "--model", model_name, "--image-size", str(image_size),
+            "--serve-buckets", ",".join(str(b) for b in buckets),
+            "--seed", "3", "--ckpt-path", leg_root,
+        ])
+        spec = {
+            "fleet_dir": os.path.join(leg_root, "serve-fleet"),
+            "events_dir": leg_root,
+            "hparams": worker_hparams_dict(hp),
+            "port_base": 0,
+            "metrics_port_base": 0,
+            "platform": platform,
+            "run_id": bus.run_id,
+            "attempt": 0,
+            "aot_dir": aot_dir,
+        }
+        tracer = obs.RequestTracer(bus=bus, sample_rate=sample, seed=3)
+        return leg_root, bus, spec, tracer
+
+    def trace_rc(leg_root):
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "run_report.py"),
+             leg_root, "--trace"],
+        ).returncode
+
+    # ---- leg 1: the hot path in isolation ----------------------------
+    def hotpath_leg():
+        n_batches, per_batch = 2000, 8
+
+        def run(sample, bind_dir):
+            bus = None
+            if bind_dir is not None:
+                bus = obs.EventBus(run_id=obs.new_run_id())
+                bus.bind_dir(bind_dir)
+            tr = obs.RequestTracer(bus=bus, sample_rate=sample, seed=3)
+            img = np.zeros((1,), np.uint8)  # payload is not the cost
+            t0 = time.perf_counter()
+            for _ in range(n_batches):
+                batch = []
+                for _ in range(per_batch):
+                    fut = ServeFuture(time.monotonic(), None, cls="default")
+                    fut.trace = tr.begin("default")
+                    tr.enqueued(fut.trace)
+                    fut.trace.t_taken = time.monotonic()
+                    batch.append((img, fut))
+                bsid = tr.batch_begin(batch, 0)
+                tr.wire_header(batch, bsid, 0)
+                tr.batch_end(batch, bsid, device_s=0.001)
+                for _, fut in batch:
+                    fut.set_result(img)
+                    tr.finish(fut, "completed")
+            per_req_us = (
+                (time.perf_counter() - t0) / (n_batches * per_batch) * 1e6
+            )
+            if bus is not None:
+                bus.close()
+            return round(per_req_us, 3)
+
+        # warm both paths once so neither sample pays first-call costs
+        run(0.0, None), run(1.0, os.path.join(root, "hot-warm"))
+        off = run(0.0, os.path.join(root, "hot-0"))
+        full = run(1.0, os.path.join(root, "hot-1"))
+        out = {
+            "requests": n_batches * per_batch,
+            "batch_size": per_batch,
+            "per_request_us_sample_0": off,
+            "per_request_us_sample_1": full,
+            "budget_us": budget_us,
+            "within_budget": off <= budget_us,
+        }
+        if not out["within_budget"]:
+            raise RuntimeError(
+                f"tracer hot path {off}us/request blows the "
+                f"{budget_us}us budget"
+            )
+        return out
+
+    leg("hotpath", hotpath_leg)
+
+    # ---- leg 2: tail-kept breaches on a real fleet -------------------
+    def tail_leg():
+        leg_root, bus, spec, tracer = leg_setup("fleet_tail", 0.0)
+        pool = request_pool(
+            64, image_size=image_size, seed=0, fold=("trace", "tail")
+        )
+        r = ServeRouter(
+            None, replicas=1, transport="process", process_spec=spec,
+            bus=bus, queue_limit=1024, emit_every_s=1.0, tracer=tracer,
+        )
+        try:
+            if not r.wait_ready(n=1, timeout=900):
+                raise RuntimeError("tail leg's fleet never went ready")
+            t0 = time.perf_counter()
+            for i in range(8):  # healthy warm traffic: must keep nothing
+                r.submit(pool[i]).result(timeout=600)
+            probe_ms = (time.perf_counter() - t0) / 8 * 1e3
+            deadline_ms = max(2.0, probe_ms * 0.5)
+            futs = [
+                r.submit(pool[i % len(pool)], deadline_ms=deadline_ms)
+                for i in range(24)
+            ]
+            breached = expired = 0
+            for f in futs:
+                try:
+                    f.result(timeout=600)
+                    breached += 0 if f.within_deadline else 1
+                except DeadlineExceeded:
+                    expired += 1
+        finally:
+            r.close()
+        obs.reset(bus)
+        out = {
+            "probe_ms": round(probe_ms, 2),
+            "deadline_ms": round(deadline_ms, 2),
+            "breached": breached,
+            "expired": expired,
+            "kept": tracer.kept,
+            "kept_by_reason": dict(tracer.kept_by_reason),
+            "healthy_dropped": tracer.dropped,
+            "events_check_rc": events_check_rc(
+                leg_root, require_kinds=("trace", "serve_route")
+            ),
+            "run_report_trace_rc": trace_rc(leg_root),
+        }
+        if breached + expired == 0:
+            raise RuntimeError("tail leg produced no deadline pressure")
+        if tracer.kept < breached + expired:
+            raise RuntimeError(
+                f"tail keep missed work: {tracer.kept} kept < "
+                f"{breached} breached + {expired} expired"
+            )
+        return out
+
+    leg("fleet_tail", tail_leg)
+
+    # ---- leg 3: sample 1.0 + autoscaler wait drift -------------------
+    def full_leg():
+        leg_root, bus, spec, tracer = leg_setup("fleet_full", 1.0)
+        pool = request_pool(
+            64, image_size=image_size, seed=0, fold=("trace", "full")
+        )
+        r = ServeRouter(
+            None, replicas=1, transport="process", process_spec=spec,
+            bus=bus, queue_limit=1024, emit_every_s=1.0, tracer=tracer,
+        )
+        scaler = Autoscaler(
+            r.metrics, parse_scale_targets("p99=2000"),
+            min_replicas=1, max_replicas=2,
+            window_s=6.0, cooldown_s=3.0, hold=2, bus=bus,
+        )
+        r.attach_autoscaler(scaler)
+        r._scale_every_s = 0.5
+        try:
+            if not r.wait_ready(n=1, timeout=900):
+                raise RuntimeError("full leg's fleet never went ready")
+            t0 = time.perf_counter()
+            for i in range(8):
+                r.submit(pool[i]).result(timeout=600)
+            warm_s = (time.perf_counter() - t0) / 8
+            # OPEN loop below one replica's capacity: a closed loop
+            # would pin utilization at 1 and the modeled wait at
+            # infinity — the drift comparison needs a finite model
+            rate = min(8.0, max(2.0, 0.4 / warm_s))
+            rep = open_loop(
+                r, pool, rate_rps=rate,
+                num_requests=max(48, int(rate * 10)), seed=1,
+            )
+        finally:
+            r.close()
+        obs.reset(bus)
+        waits = [
+            {
+                "modeled_s": p.get("wait_modeled_s"),
+                "measured_s": p.get("wait_measured_s"),
+            }
+            for e in obs.load_events(os.path.join(leg_root, "events.jsonl"))
+            if e.get("kind") == "serve_scale"
+            for p in [e.get("payload") or {}]
+            if "wait_measured_s" in p
+        ]
+        both = [
+            w for w in waits
+            if w["measured_s"] is not None and w["modeled_s"] is not None
+        ]
+        drift = None
+        if both:
+            drift = round(
+                both[-1]["measured_s"]["p50"] - both[-1]["modeled_s"], 6
+            )
+        out = {
+            "requests": rep["completed"],
+            "open_loop_rate_rps": round(rate, 2),
+            "sampled_p50_ms": rep["latency_ms"]["p50"],
+            "sampled_p99_ms": rep["latency_ms"]["p99"],
+            "kept": tracer.kept,
+            "scale_decisions_with_wait": len(waits),
+            "wait_last": both[-1] if both else (waits[-1] if waits else None),
+            "wait_drift_p50_vs_model_s": drift,
+            "events_check_rc": events_check_rc(
+                leg_root, require_kinds=("trace", "serve_scale")
+            ),
+            "run_report_trace_rc": trace_rc(leg_root),
+        }
+        if not both:
+            raise RuntimeError(
+                "no serve_scale decision carried a measured wait next to "
+                "a finite modeled one"
+            )
+        return out
+
+    leg("fleet_full", full_leg)
+
+    # ---- leg 4: one trace across a kill-requeue ----------------------
+    def kill_leg():
+        leg_root, bus, spec, tracer = leg_setup("kill_requeue", 0.0)
+        pool = request_pool(
+            64, image_size=image_size, seed=0, fold=("trace", "kill")
+        )
+        r = ServeRouter(
+            None, replicas=2, transport="process", process_spec=spec,
+            bus=bus, queue_limit=1024, emit_every_s=1.0, tracer=tracer,
+        )
+        try:
+            if not r.wait_ready(n=2, timeout=900):
+                raise RuntimeError("kill leg's fleet never went ready")
+            victim = r.replicas[0]
+            pid = victim.pid
+            futs = [r.submit(pool[i % len(pool)]) for i in range(96)]
+            deadline = time.monotonic() + 120
+            while victim.dispatches < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            os.kill(pid, signal.SIGKILL)
+            completed = len([f.result(timeout=600) for f in futs])
+            failed = r.metrics.failed
+        finally:
+            r.close()
+        obs.reset(bus)
+        sys.path.insert(0, os.path.join(repo, "tools"))
+        import run_report as _rr
+
+        events = []
+        for f in _rr.find_event_files(leg_root):
+            events.extend(obs.load_events(f))
+        requeued = [
+            row for row in _rr.trace_rows(events)
+            if row["keep"] == "requeued"
+        ]
+        out = {
+            "requests": 96,
+            "completed": completed,
+            "failed": failed,
+            "requeued_traces": len(requeued),
+            "one_trace_spans_both_replicas": bool(
+                requeued and len(requeued[0]["rids"]) >= 2
+            ),
+            "events_check_rc": events_check_rc(
+                leg_root, require_kinds=("trace", "replica")
+            ),
+            "run_report_trace_rc": trace_rc(leg_root),
+        }
+        if not requeued:
+            raise RuntimeError("kill-requeued request kept no trace")
+        return out
+
+    leg("kill_requeue", kill_leg)
+
+    check_rcs = [
+        v.get("events_check_rc") for v in legs.values()
+        if isinstance(v, dict) and "events_check_rc" in v
+    ]
+    trace_rcs = [
+        v.get("run_report_trace_rc") for v in legs.values()
+        if isinstance(v, dict) and "run_report_trace_rc" in v
+    ]
+    record = {
+        "metric": "cifar100_resnet18_request_tracing",
+        "version": 1,
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "model": model_name,
+        "image_size": image_size,
+        "buckets": list(buckets),
+        "budget_us_per_request": budget_us,
+        "all_events_checks_ok": bool(check_rcs)
+        and all(rc == 0 for rc in check_rcs),
+        "all_trace_reports_ok": bool(trace_rcs)
+        and all(rc == 0 for rc in trace_rcs),
+        "legs": legs,
+        "note": (
+            "CPU capture: absolute latencies are 1-core service times at "
+            "16px and the wait-drift magnitude reflects core contention, "
+            "not the paper's accelerator claim.  What binds: the "
+            "sampling-0 hot path under the 25us/request budget, every "
+            "breached/expired/requeued request reconstructable from "
+            "event files alone (exit-0 --trace reports), and modeled "
+            "vs measured queue wait recorded side by side."
+        ),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(record))
+    return record
+
+
 def _drive_fleet_gauntlet(
     ckpt_root: str, proc, driver_log: list, readmit,
     timeout: float = 600.0,
@@ -4433,6 +4812,8 @@ if __name__ == "__main__":
         )
     elif "--serve-fleet" in sys.argv:
         bench_serve_fleet()
+    elif "--trace" in sys.argv:
+        bench_trace()
     elif "--serve" in sys.argv:
         bench_serve()
     elif "--resilience" in sys.argv:

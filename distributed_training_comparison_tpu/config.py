@@ -561,6 +561,20 @@ def build_parser(backend: str = "single") -> argparse.ArgumentParser:
         "Empty = fixed fleet.  E.g. 'p99=400' or 'gold:p99=150'",
     )
     parser.add_argument(
+        "--serve-trace-sample",
+        type=float,
+        default=0.0,
+        help="Head-sample rate for request tracing (obs/reqtrace.py), in "
+        "[0, 1].  Every request carries trace context either way; full "
+        "span records are always kept for shed / expired / "
+        "deadline-breached / requeued / errored requests (tail-based "
+        "keep), plus a seeded fraction of healthy ones at this rate.  "
+        "0 = tail-only (the near-free default); run_report --trace "
+        "merges kept spans across the router's and every replica "
+        "process's event files into the per-class critical-path "
+        "decomposition",
+    )
+    parser.add_argument(
         "--serve-port-base",
         type=int,
         default=0,
@@ -1297,6 +1311,11 @@ def load_config(
             parse_scale_targets(args.serve_scale_target)
         except ValueError as e:
             parser.error(str(e))
+    if not 0.0 <= args.serve_trace_sample <= 1.0:
+        parser.error(
+            f"--serve-trace-sample must be in [0, 1], got "
+            f"{args.serve_trace_sample}"
+        )
     if args.serve_port_base < 0 or args.serve_port_base > 65535:
         parser.error(
             f"--serve-port-base must be in [0, 65535], got "

@@ -144,6 +144,12 @@ from .metrics import (
     merge_histograms,
     merge_metric_events,
 )
+from .reqtrace import (
+    TRACE_KIND,
+    RequestTracer,
+    TraceContext,
+    WorkerTraceRing,
+)
 from .resource import ResourceSampler
 from .spans import (
     SpanRecorder,
@@ -174,6 +180,10 @@ __all__ = [
     "STRAGGLER_KIND",
     "ALERT_KIND",
     "COMPILE_KIND",
+    "TRACE_KIND",
+    "RequestTracer",
+    "TraceContext",
+    "WorkerTraceRing",
     "PEAK_FLOPS_BY_DEVICE_KIND",
     "CompileMonitor",
     "ExecutableRecord",

@@ -152,14 +152,16 @@ def decode_ring(path: str | Path) -> tuple[list[dict], int]:
 
 def find_rings(root: str | Path) -> list[Path]:
     """Every flight ring under a checkpoint root (the root itself, the
-    version dirs) — one per attempt per process, all hosts' rings visible
-    because the ckpt root is the shared filesystem multi-host already
-    contractually requires."""
+    version dirs, and first-level subdirs like the serve fleet's
+    ``serve-fleet/`` — replica worker processes attach rings there) —
+    one per attempt per process, all hosts' rings visible because the
+    ckpt root is the shared filesystem multi-host already contractually
+    requires."""
     root = Path(root)
     if root.is_file():
         return [root]
-    return sorted(root.glob("flight*.ring")) + sorted(
-        root.glob("version-*/flight*.ring")
+    return sorted(
+        set(root.glob("flight*.ring")) | set(root.glob("*/flight*.ring"))
     )
 
 

@@ -122,6 +122,12 @@ KNOWN_KINDS = {
     # divergent (step, stage, leaf, ulp) when either gate trips, and the
     # layout under test; run_report --parity renders and gates on it
     "parity",
+    # request tracing (obs/reqtrace): one event per KEPT trace on the
+    # router's bus (the span tree: admit/queue/coalesce/batch/rpc/reply,
+    # keep reason, requeue count), plus per-batch device spans on each
+    # replica process's own bus (events-p{1+rid}.jsonl) joined on
+    # trace_id; run_report --trace merges and decomposes them per class
+    "trace",
 }
 
 

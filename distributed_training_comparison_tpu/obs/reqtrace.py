@@ -1,0 +1,467 @@
+"""End-to-end request tracing for the serve path, with tail-based keep.
+
+PR-17 promoted replicas to real OS processes behind a socket — and broke
+the one-interpreter visibility the serve metrics relied on: latency
+histograms say a gold request breached its p99, but nothing can say
+WHERE (admission? queue? coalescing window? socket hop? device?).  This
+module is the identity that crosses the frame:
+
+- :class:`RequestTracer` (router process) mints a ``(trace_id, span_id)``
+  per request at ``ClassQueue.submit`` and rides it on the request's
+  future through the queue, batch coalescing, both transports, and the
+  reply.  The hot path only *stamps monotonic timestamps on the context*
+  — span records materialize at the request's terminal decision, and
+  only for kept traces, so the per-request cost at sampling 0 is a few
+  attribute writes.
+- **Tail-based sampling**: every request carries context; full span
+  records are kept for (a) a seeded head-sample rate
+  (``--serve-trace-sample``), and (b) retroactively for every shed /
+  expired / deadline-breached / requeued / errored request — the traces
+  an operator actually greps for.  Dropped traces cost nothing but the
+  stamps.
+- :class:`WorkerTraceRing` (replica process) buffers per-batch device
+  spans in a bounded ring and emits them on the worker's OWN bus
+  (``events-p{1+rid}.jsonl``) — eagerly when the submit header marks a
+  request kept, retroactively when a later frame's ``flush`` list names
+  a trace the router tail-kept after the reply (deadline breaches are
+  only known at completion).  A request requeued off a killed replica
+  keeps ONE trace: the failed ``rpc`` span names the dead rid
+  (``requeued`` annotation), the retry's spans name the survivor.
+
+Span records ride registered ``trace`` bus events (payload-only — the
+event envelope stays the versioned schema), so ``run_report --trace``
+merges them across the router's and every replica's event files with the
+same clock-skew machinery every other report uses, and renders the
+per-SLO-class critical-path decomposition.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+
+TRACE_KIND = "trace"
+
+# tail-keep reasons, in decision order (most specific first); "sampled"
+# is the head-sample and loses to every tail reason in the record
+KEEP_REASONS = (
+    "shed", "expired", "failed", "requeued", "deadline_breach", "sampled",
+)
+
+# bounded sketch of measured queue waits from kept traces — the
+# autoscaler's wait_measured_s ground truth (Algorithm R, seeded)
+WAIT_RESERVOIR = 512
+
+# per-worker bounded buffer of un-kept batch device spans awaiting a
+# possible retroactive flush; sized to cover the dispatches between a
+# reply and the tail-keep decision riding the next frame
+WORKER_RING_SLOTS = 128
+
+
+class TraceContext:
+    """One request's trace identity + hot-path timestamps.
+
+    All stamps are ``time.monotonic()`` of the ROUTER process;
+    ``wall()`` projects them onto the wall clock anchored at submit so
+    cross-process merge (worker spans carry their own wall stamps) works
+    through the skew estimator.  ``attempts`` records one row per
+    dispatch — a requeued request accumulates several, each naming the
+    replica it was sent to (the kill-requeue trace spans both).
+    """
+
+    __slots__ = (
+        "trace_id", "span_id", "cls", "sampled", "keep", "requeues",
+        "deadline_ms", "t0_wall", "t0", "t_enq", "t_taken", "attempts",
+        "done",
+    )
+
+    def __init__(
+        self, trace_id: str, span_id: str, cls: str, sampled: bool,
+        deadline_ms: float | None = None,
+    ) -> None:
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.cls = cls
+        self.sampled = sampled
+        self.keep = False        # tail-keep decided mid-flight (requeue)
+        self.requeues = 0
+        self.deadline_ms = deadline_ms
+        self.t0_wall = time.time()
+        self.t0 = time.monotonic()
+        self.t_enq: float | None = None
+        self.t_taken: float | None = None
+        # one row per dispatch attempt:
+        # [batch_span_id, rid, n, t_start, t_end, device_s, ok, requeued]
+        self.attempts: list = []
+        self.done = False
+
+    def wall(self, t_mono: float) -> float:
+        return self.t0_wall + (t_mono - self.t0)
+
+
+class RequestTracer:
+    """Router-process tracer: mint, stamp, decide keep, emit.
+
+    Thread-safe where it must be (id minting, the flush ledger, the wait
+    sketch); the per-context stamps are written by whichever single
+    thread owns the request at that moment (submit caller → queue lock →
+    one replica dispatcher), so they need no locking of their own.
+    """
+
+    def __init__(
+        self, bus=None, *, sample_rate: float = 0.0, seed: int = 0,
+        wait_reservoir: int = WAIT_RESERVOIR,
+    ) -> None:
+        if not 0.0 <= float(sample_rate) <= 1.0:
+            raise ValueError(
+                f"trace sample rate must be in [0, 1], got {sample_rate}"
+            )
+        self.bus = bus
+        self.sample_rate = float(sample_rate)
+        self._rng = random.Random(int(seed) ^ 0x7261636554)  # "Tracer"
+        self._lock = threading.Lock()
+        # rid -> trace_ids whose buffered worker spans must be flushed
+        self._flush: dict[int, set] = {}
+        # seeded Algorithm-R reservoir of queue waits from KEPT traces
+        self._waits: list = []
+        self._waits_seen = 0
+        self._wait_cap = max(1, int(wait_reservoir))
+        self.kept = 0
+        self.dropped = 0
+        self.kept_by_reason: dict[str, int] = {}
+
+    # ------------------------------------------------------------- mint
+
+    def begin(self, cls: str, deadline_ms: float | None = None,
+              ) -> TraceContext:
+        """Mint one request's context (at ``ClassQueue.submit``)."""
+        with self._lock:
+            tid = f"{self._rng.getrandbits(64):016x}"
+            sid = f"{self._rng.getrandbits(32):08x}"
+            sampled = (
+                self.sample_rate > 0.0
+                and self._rng.random() < self.sample_rate
+            )
+        return TraceContext(tid, sid, cls, sampled, deadline_ms)
+
+    # ------------------------------------------------------ hot stamps
+
+    @staticmethod
+    def enqueued(ctx: TraceContext | None) -> None:
+        if ctx is not None:
+            ctx.t_enq = time.monotonic()
+
+    def batch_begin(self, batch, rid: int | None = None) -> str:
+        """One coalesced batch dispatches: mint the shared ``batch`` span
+        id and open an attempt row on every traced member.  The batch
+        span fans into the members' child spans at materialize time."""
+        with self._lock:
+            bsid = f"{self._rng.getrandbits(32):08x}"
+        t = time.monotonic()
+        n = len(batch)
+        for _, fut in batch:
+            ctx = getattr(fut, "trace", None)
+            if ctx is not None:
+                ctx.attempts.append([bsid, rid, n, t, None, None, False,
+                                     False])
+        return bsid
+
+    @staticmethod
+    def batch_end(
+        batch, bsid: str, *, ok: bool = True, requeued: bool = False,
+        device_s: float | None = None,
+    ) -> None:
+        """Close the attempt rows ``batch_begin`` opened (reply decoded,
+        engine returned, or the transport tore)."""
+        t = time.monotonic()
+        for _, fut in batch:
+            ctx = getattr(fut, "trace", None)
+            if ctx is None:
+                continue
+            for row in reversed(ctx.attempts):
+                if row[0] == bsid:
+                    row[4] = t
+                    row[5] = device_s
+                    row[6] = ok
+                    row[7] = requeued
+                    break
+
+    @staticmethod
+    def mark_requeued(fut) -> None:
+        """The request survives its replica's death: annotate and flip
+        the tail-keep flag so the retry's wire context emits eagerly —
+        one trace, both replicas."""
+        ctx = getattr(fut, "trace", None)
+        if ctx is not None:
+            ctx.requeues += 1
+            ctx.keep = True
+
+    # ------------------------------------------------------------- wire
+
+    def wire_header(self, batch, bsid: str, rid: int) -> dict:
+        """The ``trace`` field of a submit frame header: per-row
+        ``[trace_id, keep_now]`` pairs (aligned with the batch rows),
+        the shared batch span id, and any pending retro-flush ids for
+        this worker.  A worker that sees no ``trace`` field behaves as
+        today — the extension is backward-compatible by construction."""
+        reqs = []
+        for _, fut in batch:
+            ctx = getattr(fut, "trace", None)
+            reqs.append(
+                None if ctx is None else
+                [ctx.trace_id, 1 if (ctx.sampled or ctx.keep) else 0]
+            )
+        hdr: dict = {"reqs": reqs, "batch": bsid}
+        flush = self.take_flush(rid)
+        if flush:
+            hdr["flush"] = flush
+        return hdr
+
+    def request_flush(self, rid: int, trace_id: str) -> None:
+        with self._lock:
+            self._flush.setdefault(int(rid), set()).add(trace_id)
+
+    def take_flush(self, rid: int) -> list:
+        """Pop the retro-flush ids pending for worker ``rid`` (they ride
+        the next frame to it — submit or drain)."""
+        with self._lock:
+            ids = self._flush.pop(int(rid), None)
+        return sorted(ids) if ids else []
+
+    # --------------------------------------------------------- terminal
+
+    def finish(self, fut, outcome: str) -> None:
+        """The request reached a terminal state: decide keep, and emit
+        the materialized spans for kept traces.  Idempotent (first call
+        wins — mirrors the future's own first-wins resolution)."""
+        ctx = getattr(fut, "trace", None)
+        if ctx is None:
+            return
+        self.finish_ctx(ctx, outcome, fut=fut)
+
+    def finish_ctx(self, ctx: TraceContext, outcome: str, fut=None) -> None:
+        if ctx.done:
+            return
+        ctx.done = True
+        breach = False
+        if fut is not None and outcome == "completed":
+            breach = not fut.within_deadline
+        if outcome in ("shed", "expired", "failed"):
+            reason = outcome
+        elif ctx.requeues:
+            reason = "requeued"
+        elif breach:
+            reason = "deadline_breach"
+        elif ctx.sampled:
+            reason = "sampled"
+        else:
+            self.dropped += 1
+            return
+        self.kept += 1
+        self.kept_by_reason[reason] = self.kept_by_reason.get(reason, 0) + 1
+        if ctx.t_enq is not None and ctx.t_taken is not None:
+            self._note_wait(ctx.t_taken - ctx.t_enq)
+        # device spans for this trace buffered in worker rings (the wire
+        # keep flag was 0 at dispatch time): ask for them on the next
+        # frame to each worker that served an attempt
+        if not ctx.sampled and not ctx.keep:
+            for row in ctx.attempts:
+                if row[6] and row[1] is not None and row[5] is None:
+                    self.request_flush(row[1], ctx.trace_id)
+        if self.bus is not None:
+            done_t = getattr(fut, "done_t", None) if fut is not None else None
+            self.bus.emit(
+                TRACE_KIND,
+                trace_id=ctx.trace_id,
+                cls=ctx.cls,
+                keep=reason,
+                sampled=ctx.sampled,
+                outcome=outcome,
+                breach=breach,
+                requeues=ctx.requeues,
+                deadline_ms=ctx.deadline_ms,
+                spans=self._spans(ctx, done_t),
+            )
+
+    def _spans(self, ctx: TraceContext, done_t: float | None) -> list:
+        """Materialize the span tree from the context's stamps."""
+        w = ctx.wall
+        stamps = [ctx.t0, ctx.t_enq, ctx.t_taken, done_t]
+        stamps += [row[4] if row[4] is not None else row[3]
+                   for row in ctx.attempts]
+        end = max(t for t in stamps if t is not None)
+        spans = [{
+            "name": "request", "span_id": ctx.span_id, "parent": None,
+            "t0_wall": round(ctx.t0_wall, 6),
+            "dur_s": round(end - ctx.t0, 6),
+        }]
+        if ctx.t_enq is not None:
+            spans.append({
+                "name": "admit", "parent": ctx.span_id,
+                "t0_wall": round(ctx.t0_wall, 6),
+                "dur_s": round(ctx.t_enq - ctx.t0, 6),
+            })
+        if ctx.t_enq is not None and ctx.t_taken is not None:
+            spans.append({
+                "name": "queue", "parent": ctx.span_id,
+                "t0_wall": round(w(ctx.t_enq), 6),
+                "dur_s": round(ctx.t_taken - ctx.t_enq, 6),
+            })
+        last_ok = None
+        for row in ctx.attempts:
+            bsid, rid, n, t_start, t_end, device_s, ok, requeued = row
+            t_end = t_end if t_end is not None else t_start
+            # the shared batch span: same span_id across every kept
+            # member trace of the batch — the fan-out is the id reuse
+            spans.append({
+                "name": "batch", "span_id": bsid, "parent": ctx.span_id,
+                "t0_wall": round(w(t_start), 6),
+                "dur_s": round(t_end - t_start, 6),
+                "n": n, "rid": rid,
+            })
+            if ctx.t_taken is not None and t_start >= ctx.t_taken:
+                spans.append({
+                    "name": "coalesce", "parent": bsid,
+                    "t0_wall": round(w(ctx.t_taken), 6),
+                    "dur_s": round(t_start - ctx.t_taken, 6),
+                })
+            child = {
+                "name": "device" if device_s is not None else "rpc",
+                "parent": bsid, "rid": rid,
+                "t0_wall": round(w(t_start), 6),
+                "dur_s": round(
+                    device_s if device_s is not None else t_end - t_start,
+                    6,
+                ),
+            }
+            if requeued:
+                child["requeued"] = True
+            if not ok:
+                child["ok"] = False
+            spans.append(child)
+            if ok:
+                last_ok = (bsid, t_end)
+        if last_ok is not None and done_t is not None:
+            bsid, t_end = last_ok
+            spans.append({
+                "name": "reply", "parent": bsid,
+                "t0_wall": round(w(t_end), 6),
+                "dur_s": round(max(0.0, done_t - t_end), 6),
+            })
+        return spans
+
+    # ---------------------------------------------------- measured wait
+
+    def _note_wait(self, wait_s: float) -> None:
+        with self._lock:
+            self._waits_seen += 1
+            if len(self._waits) < self._wait_cap:
+                self._waits.append(wait_s)
+            else:
+                j = self._rng.randrange(self._waits_seen)
+                if j < self._wait_cap:
+                    self._waits[j] = wait_s
+
+    def queue_wait_stats(self) -> dict | None:
+        """Measured queue-wait quantiles (seconds) from kept traces —
+        the ground truth the autoscaler records next to its Sakasegawa
+        ``wait_modeled_s``.  None until a kept trace has a queue span."""
+        with self._lock:
+            waits = sorted(self._waits)
+            seen = self._waits_seen
+        if not waits:
+            return None
+        q = lambda f: waits[min(len(waits) - 1, int(f * len(waits)))]
+        return {
+            "n": seen,
+            "p50": round(q(0.50), 6),
+            "p95": round(q(0.95), 6),
+            "p99": round(q(0.99), 6),
+            "mean": round(sum(waits) / len(waits), 6),
+        }
+
+    def describe(self) -> dict:
+        return {
+            "sample_rate": self.sample_rate,
+            "kept": self.kept,
+            "dropped": self.dropped,
+            "kept_by_reason": dict(self.kept_by_reason),
+            "queue_wait_s": self.queue_wait_stats(),
+        }
+
+
+class WorkerTraceRing:
+    """Replica-process side: bounded buffer of per-batch device spans.
+
+    ``record`` is called once per submit frame that carried a ``trace``
+    field: the batch's device span is appended to the ring, emitted
+    immediately on the worker's own bus for rows whose wire flag says
+    keep-now, and any ``flush`` ids the frame piggybacked are re-emitted
+    from the ring (the router tail-kept them after their reply — e.g. a
+    deadline breach, known only at completion).  Emitted ids are tracked
+    per entry so a flush never duplicates an eager emit.  A SIGKILLed
+    worker loses its unflushed ring — its EMITTED events survive in its
+    event file and blackbox flight ring.
+    """
+
+    def __init__(self, bus, replica: int, slots: int = WORKER_RING_SLOTS):
+        self.bus = bus
+        self.replica = int(replica)
+        self._ring: deque = deque(maxlen=max(1, int(slots)))
+        self._lock = threading.Lock()
+
+    def record(self, hdr: dict, t0_wall: float, dur_s: float, n: int,
+               ) -> None:
+        reqs = hdr.get("reqs") or []
+        rec = {
+            "t0_wall": round(float(t0_wall), 6),
+            "dur_s": round(float(dur_s), 6),
+            "batch": hdr.get("batch"),
+            "n": int(n),
+            "tids": [r[0] for r in reqs if r],
+            "emitted": set(),
+        }
+        keep_now = [r[0] for r in reqs if r and len(r) > 1 and r[1]]
+        with self._lock:
+            self._ring.append(rec)
+            if keep_now:
+                self._emit(rec, keep_now)
+            fl = hdr.get("flush")
+            if fl:
+                self._flush_locked(fl)
+
+    def flush(self, trace_ids) -> int:
+        """Retro-emit buffered device spans for ``trace_ids`` (the drain
+        frame's final flush).  Returns how many ids were emitted."""
+        with self._lock:
+            return self._flush_locked(trace_ids)
+
+    def _flush_locked(self, trace_ids) -> int:
+        wanted = set(trace_ids or ())
+        emitted = 0
+        for rec in self._ring:
+            hit = [t for t in rec["tids"]
+                   if t in wanted and t not in rec["emitted"]]
+            if hit:
+                self._emit(rec, hit)
+                emitted += len(hit)
+        return emitted
+
+    def _emit(self, rec: dict, tids) -> None:
+        rec["emitted"].update(tids)
+        if self.bus is None:
+            return
+        self.bus.emit(
+            TRACE_KIND,
+            trace_ids=sorted(tids),
+            span={
+                "name": "device",
+                "t0_wall": rec["t0_wall"],
+                "dur_s": rec["dur_s"],
+                "batch": rec["batch"],
+                "rid": self.replica,
+                "n": rec["n"],
+            },
+        )

@@ -364,6 +364,20 @@ def serve_main(hparams) -> dict:
         alert_engine = obs.AlertEngine(obs.parse_alert_specs(specs), bus=bus)
         bus.subscribe(alert_engine.observe_event)
     metrics = ServeMetrics(bus=bus, registry=registry, classes=classes)
+    # end-to-end request tracing (obs/reqtrace.py): every request carries
+    # a (trace_id, span_id); tail-based keep means shed / expired /
+    # breached / requeued / errored requests always trace, healthy ones
+    # at --serve-trace-sample.  Only built when the bus exists — span
+    # records without an event file would have nowhere to go.
+    tracer = None
+    if bus is not None:
+        tracer = obs.RequestTracer(
+            bus=bus,
+            sample_rate=float(
+                getattr(hparams, "serve_trace_sample", 0.0) or 0.0
+            ),
+            seed=int(getattr(hparams, "seed", 0) or 0),
+        )
     # --- transport: thread (N engines here) or process (serve/fleet/ —
     # each replica a supervised OS process behind the socket transport)
     transport = str(getattr(hparams, "serve_transport", "thread"))
@@ -404,6 +418,7 @@ def serve_main(hparams) -> dict:
         monitor=monitor,
         transport=transport,
         process_spec=process_spec,
+        tracer=tracer,
         start=False,
     )
     # --- queueing-aware autoscaling (--serve-scale-target): fit a G/G/m

@@ -188,7 +188,7 @@ class ServeFuture:
 
     __slots__ = (
         "_event", "_value", "_error", "_resolve_lock", "submit_t",
-        "done_t", "deadline_t", "cls",
+        "done_t", "deadline_t", "cls", "trace",
     )
 
     def __init__(
@@ -203,6 +203,9 @@ class ServeFuture:
         self.done_t: float | None = None
         self.deadline_t = deadline_t
         self.cls = cls
+        # request-trace context (obs/reqtrace) — rides the future through
+        # queue, coalescing, transport, and reply; None when untraced
+        self.trace = None
 
     def set_result(self, value) -> bool:
         with self._resolve_lock:
@@ -261,12 +264,16 @@ class ClassQueue:
         classes: dict[str, SLOClass] | None = None,
         limit: int = 256,
         metrics: ServeMetrics | None = None,
+        tracer=None,
     ) -> None:
         self.classes = dict(classes) if classes else default_classes()
         self.limit = int(limit)
         if self.limit < 1:
             raise ValueError("queue limit must be >= 1")
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        # obs.RequestTracer (or None): every admission mints a trace
+        # context on the future; terminal sites below report to it
+        self.tracer = tracer
         self._cond = threading.Condition()
         # one FIFO per priority level; take() walks priorities ascending
         # (most important first), eviction walks descending
@@ -303,16 +310,28 @@ class ClassQueue:
         with self._cond:
             if self._closed:
                 raise BatcherClosed("submit after close()")
+            # mint the trace identity at admission — every request
+            # carries context; whether its spans are KEPT is decided at
+            # its terminal state (tail-based sampling)
+            ctx = (
+                self.tracer.begin(slo.name, deadline)
+                if self.tracer is not None else None
+            )
             if self._n >= self.limit:
                 victim = self._evict_below(slo.priority)
                 if victim is None:
                     self.metrics.record_shed(slo.name)
+                    if ctx is not None:
+                        self.tracer.finish_ctx(ctx, "shed")
                     raise QueueOverflow(
                         f"queue depth {self._n} at the configured limit "
                         f"{self.limit}; {slo.name!r} request shed (nothing "
                         "queued is lower-priority)"
                     )
             fut = ServeFuture(now, deadline_t, cls=slo.name)
+            fut.trace = ctx
+            if ctx is not None:
+                self.tracer.enqueued(ctx)
             self._lanes.setdefault(slo.priority, deque()).append(
                 (np.asarray(image), fut)
             )
@@ -326,12 +345,13 @@ class ClassQueue:
             # resolved OUTSIDE the lock: the victim's waiter may react
             _, vfut = victim
             self.metrics.record_shed(vfut.cls)
-            vfut.set_error(
+            if vfut.set_error(
                 QueueOverflow(
                     f"{vfut.cls!r} request shed: queue full and a "
                     f"higher-priority {slo.name!r} request arrived"
                 )
-            )
+            ) and self.tracer is not None:
+                self.tracer.finish(vfut, "shed")
         return fut
 
     def _evict_below(self, priority: int):
@@ -375,13 +395,16 @@ class ClassQueue:
                 self._n -= 1
                 if fut.deadline_t is not None and now > fut.deadline_t:
                     self.metrics.record_expired(fut.cls, pre_dispatch=True)
-                    fut.set_error(
+                    if fut.set_error(
                         DeadlineExceeded(
                             f"deadline lapsed {(now - fut.deadline_t) * 1e3:.1f}"
                             " ms before dispatch"
                         )
-                    )
+                    ) and self.tracer is not None:
+                        self.tracer.finish(fut, "expired")
                     continue
+                if fut.trace is not None:
+                    fut.trace.t_taken = now
                 batch.append((image, fut))
             if len(batch) >= max_n:
                 break
@@ -452,13 +475,14 @@ class ClassQueue:
                         self.metrics.record_expired(
                             fut.cls, pre_dispatch=True
                         )
-                        fut.set_error(
+                        if fut.set_error(
                             DeadlineExceeded(
                                 "deadline lapsed "
                                 f"{(now - fut.deadline_t) * 1e3:.1f} ms "
                                 "inside the coalescing window"
                             )
-                        )
+                        ) and self.tracer is not None:
+                            self.tracer.finish(fut, "expired")
                     else:
                         live.append((image, fut))
                 batch = live
@@ -480,7 +504,7 @@ class ClassQueue:
         meanwhile) are skipped; on a closed queue they fail typed.
         Returns the number actually requeued.
         """
-        failed_cls = []
+        failed = []
         n = 0
         with self._cond:
             for image, fut in reversed(list(entries)):
@@ -491,12 +515,17 @@ class ClassQueue:
                         BatcherClosed("replica lost mid-dispatch during "
                                       "shutdown")
                     ):
-                        failed_cls.append(fut.cls)
+                        failed.append(fut)
                     continue
                 try:
                     priority = self.classes[fut.cls].priority
                 except KeyError:
                     priority = 1
+                if self.tracer is not None:
+                    # survives its replica's death with ONE trace: the
+                    # annotation flips the tail-keep flag, so the retry
+                    # (possibly on another replica) emits spans for both
+                    self.tracer.mark_requeued(fut)
                 self._lanes.setdefault(priority, deque()).appendleft(
                     (image, fut)
                 )
@@ -504,8 +533,10 @@ class ClassQueue:
                 n += 1
             if n:
                 self._cond.notify_all()
-        for cls in failed_cls:
-            self.metrics.record_failed(cls)
+        for fut in failed:
+            self.metrics.record_failed(fut.cls)
+            if self.tracer is not None:
+                self.tracer.finish(fut, "failed")
         return n
 
     # -------------------------------------------------------------- close
@@ -518,9 +549,10 @@ class ClassQueue:
                     while lane:
                         _, fut = lane.popleft()
                         self._n -= 1
-                        fut.set_error(
+                        if fut.set_error(
                             BatcherClosed("batcher closed undrained")
-                        )
+                        ) and self.tracer is not None:
+                            self.tracer.finish(fut, "failed")
             self._cond.notify_all()
 
     def fail_all(self, err: BaseException) -> int:
@@ -528,18 +560,20 @@ class ClassQueue:
         count.  Each one is a terminal FAILURE in its class's SLO
         accounting — abandoned work must drag attainment down."""
         n = 0
-        failed_cls = []
+        failed = []
         with self._cond:
             for lane in self._lanes.values():
                 while lane:
                     _, fut = lane.popleft()
                     self._n -= 1
                     if fut.set_error(err):
-                        failed_cls.append(fut.cls)
+                        failed.append(fut)
                         n += 1
             self._cond.notify_all()
-        for cls in failed_cls:
-            self.metrics.record_failed(cls)
+        for fut in failed:
+            self.metrics.record_failed(fut.cls)
+            if self.tracer is not None:
+                self.tracer.finish(fut, "failed")
         return n
 
 
@@ -565,6 +599,7 @@ class MicroBatcher:
         metrics: ServeMetrics | None = None,
         classes: dict[str, SLOClass] | None = None,
         mode: str = "bucketed",
+        tracer=None,
     ) -> None:
         if mode not in ("bucketed", "continuous"):
             raise ValueError(
@@ -580,7 +615,8 @@ class MicroBatcher:
             classes=classes
         )
         self.queue = ClassQueue(
-            classes=classes, limit=queue_limit, metrics=self.metrics
+            classes=classes, limit=queue_limit, metrics=self.metrics,
+            tracer=tracer,
         )
         self._worker = threading.Thread(
             target=self._loop, name="serve-batcher", daemon=True
@@ -617,7 +653,9 @@ class MicroBatcher:
                 return
             if not batch:
                 continue
-            dispatch_batch(self.engine, batch, self.metrics)
+            dispatch_batch(
+                self.engine, batch, self.metrics, tracer=self.queue.tracer
+            )
 
     # -------------------------------------------------------------- close
 
@@ -633,7 +671,10 @@ class MicroBatcher:
         self.close()
 
 
-def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> list:
+def dispatch_batch(
+    engine, batch: list, metrics: ServeMetrics, tracer=None,
+    rid: int | None = None,
+) -> list:
     """Run one coalesced batch through ``engine`` and resolve its
     futures — the shared worker body of :class:`MicroBatcher` and every
     router replica.  Engine failure fails the batch (typed, counted) and
@@ -641,17 +682,28 @@ def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> list:
     (the per-replica class-latency input; losers of a ``mark_dead`` race
     are excluded)."""
     t0 = time.monotonic()
+    bsid = tracer.batch_begin(batch, rid) if tracer is not None else None
     try:
         logits = engine.predict_logits(
             np.stack([img for img, _ in batch])
         )
     except Exception as e:  # engine failure → fail the batch, keep serving
+        if tracer is not None:
+            tracer.batch_end(batch, bsid, ok=False)
         metrics.record_error()
         for _, fut in batch:
             if fut.set_error(e):
                 metrics.record_failed(fut.cls)
+                if tracer is not None:
+                    tracer.finish(fut, "failed")
         return []
-    metrics.record_service(time.monotonic() - t0, len(batch))
+    service_s = time.monotonic() - t0
+    if tracer is not None:
+        # thread transport: the engine ran in-process, so the device
+        # span is recorded here (the process transport's worker emits
+        # its own on its own bus)
+        tracer.batch_end(batch, bsid, device_s=service_s)
+    metrics.record_service(service_s, len(batch))
     completed = []
     for (_, fut), row in zip(batch, logits):
         if not fut.set_result(row):
@@ -664,5 +716,7 @@ def dispatch_batch(engine, batch: list, metrics: ServeMetrics) -> list:
             fut.latency_s, cls=fut.cls,
             within_deadline=fut.within_deadline,
         )
+        if tracer is not None:
+            tracer.finish(fut, "completed")
         completed.append(fut)
     return completed

@@ -186,7 +186,7 @@ class Autoscaler:
         min_replicas: int = 1, max_replicas: int = 8,
         window_s: float = 30.0, cooldown_s: float = 15.0,
         hold: int = 2, headroom: float = 0.8,
-        bus=None, clock=time.monotonic,
+        bus=None, clock=time.monotonic, tracer=None,
     ) -> None:
         self.metrics = metrics
         self.targets = dict(targets)
@@ -198,6 +198,11 @@ class Autoscaler:
         self.headroom = float(headroom)
         self.bus = bus
         self._clock = clock
+        # an optional obs.RequestTracer: its measured queue-wait
+        # quantiles (from kept traces) ride every decision next to the
+        # Sakasegawa-modeled wait, so model drift is visible in the
+        # serve_scale events themselves (attach_autoscaler wires it)
+        self.tracer = tracer
         self._last_applied_t: float | None = None
         self._down_streak = 0
         self.decisions = 0
@@ -220,6 +225,19 @@ class Autoscaler:
         )
         if sized_by == "no-data":
             proposed = current  # nothing measured: hold, don't thrash
+        # the modeled wait at the CURRENT fleet size, next to the wait
+        # actually measured from kept traces — None when saturated
+        # (modeled) or no traces kept yet (measured)
+        mean_batch = max(1.0, float(svc.get("mean_batch") or 1.0))
+        wq = wq_ggm(
+            lam / mean_batch, float(svc.get("mean_s") or 0.0),
+            max(1, int(current)),
+            ca2=ca2, cs2=float(svc.get("cv2") or 1.0),
+        )
+        wait_measured = (
+            self.tracer.queue_wait_stats()
+            if self.tracer is not None else None
+        )
         return {
             "current": int(current),
             "proposed": int(proposed),
@@ -231,6 +249,10 @@ class Autoscaler:
                 for k, v in svc.items()
             },
             "rows": rows,
+            "wait_modeled_s": (
+                None if math.isinf(wq) else round(wq, 6)
+            ),
+            "wait_measured_s": wait_measured,
             "targets_ms": {
                 c: t * 1000.0 for c, t in self.targets.items()
             },

@@ -160,6 +160,14 @@ def worker_main(path: str) -> int:
     )
     if spec.get("events_dir"):
         bus.bind_dir(spec["events_dir"])
+    # the worker's own SIGKILL-surviving flight ring, named by supervisor
+    # incarnation so a relaunch never overwrites the dead attempt's ring —
+    # collect_black_box pulls it from the fleet dir with the router's
+    incarnation = int(spec.get("incarnation", 0) or 0)
+    bus.attach_ring(fleet_dir / obs.ring_filename(incarnation, 1 + rid))
+    # buffered device spans for tail-based tracing: emitted eagerly for
+    # keep-now requests, retroactively on the router's flush request
+    trace_ring = obs.WorkerTraceRing(bus, rid)
     registry = obs.MetricRegistry()
     monitor = obs.CompileMonitor(bus=bus, registry=registry)
     aot_cache = (
@@ -224,9 +232,12 @@ def worker_main(path: str) -> int:
                     images = decode_array(header, body)
                     try:
                         with engine_lock:
+                            t0_wall = time.time()
+                            t0 = time.monotonic()
                             logits = np.asarray(
                                 engine.predict_logits(images)
                             )
+                            dur = time.monotonic() - t0
                             counters["dispatches"] += 1
                             counters["served"] += int(images.shape[0])
                     except Exception as e:  # engine error: typed, not fatal
@@ -238,6 +249,11 @@ def worker_main(path: str) -> int:
                         continue
                     meta, rbody = encode_array(logits)
                     send_msg(conn, {"op": "result", **meta}, rbody)
+                    tr = header.get("trace")
+                    if tr:
+                        trace_ring.record(
+                            tr, t0_wall, dur, int(images.shape[0])
+                        )
                     beats.beat(
                         replica=rid, pid=os.getpid(),
                         dispatches=counters["dispatches"],
@@ -251,6 +267,11 @@ def worker_main(path: str) -> int:
                 elif op == "stats":
                     send_msg(conn, {"op": "stats", "stats": engine.stats()})
                 elif op == "drain":
+                    # last chance for the router's pending tail-keep
+                    # decisions to pull their buffered device spans out
+                    tf = header.get("trace_flush")
+                    if tf:
+                        trace_ring.flush(tf)
                     # finish the in-flight dispatch (the engine lock IS
                     # the in-flight marker), then ack and exit clean
                     with engine_lock:
@@ -388,7 +409,11 @@ class ProcessReplica(Replica):
             self._thread.start()
         return self
 
-    def _render_cmd(self) -> list[str]:
+    def _render_cmd(self, attempt: int = 0) -> list[str]:
+        # the supervisor attempt becomes the worker's ring incarnation:
+        # a relaunched worker writes a fresh flight ring next to (not
+        # over) the dead incarnation's, so the black box keeps both
+        self.spec["incarnation"] = int(attempt)
         path = write_worker_spec(self.fleet_dir, self.rid, self.spec)
         return [
             self.spec.get("python") or sys.executable,
@@ -435,7 +460,7 @@ class ProcessReplica(Replica):
 
     def _supervise(self) -> None:
         self._sup = _ReplicaSupervisor(
-            cmd=lambda attempt: self._render_cmd(),
+            cmd=lambda attempt: self._render_cmd(attempt),
             env=lambda attempt: self._render_env(),
             max_restarts=self._max_restarts,
             backoff_base=self._backoff_base,
@@ -537,13 +562,23 @@ class ProcessReplica(Replica):
                         )
                     ):
                         self.metrics.record_failed(fut.cls)
+                        self._finish_trace(fut, "failed")
                 if not batch:
                     return
                 self._beat()
+                tracer = getattr(self.queue, "tracer", None)
+                bsid = (
+                    tracer.batch_begin(batch, self.rid)
+                    if tracer is not None else None
+                )
                 t0 = time.monotonic()
                 try:
                     logits = client.submit_batch(
-                        np.stack([img for img, _ in batch])
+                        np.stack([img for img, _ in batch]),
+                        trace=(
+                            tracer.wire_header(batch, bsid, self.rid)
+                            if tracer is not None else None
+                        ),
                     )
                 except FleetTransportError as e:
                     # the worker vanished mid-dispatch.  Prediction is
@@ -551,6 +586,10 @@ class ProcessReplica(Replica):
                     # the FRONT of their lanes (age preserved) and let
                     # the supervisor's next incarnation serve them — a
                     # replica crash costs latency, not requests.
+                    if tracer is not None:
+                        tracer.batch_end(
+                            batch, bsid, ok=False, requeued=True
+                        )
                     with self._lock:
                         inflight, self._inflight = self._inflight, []
                     requeued = self.queue.requeue(inflight)
@@ -568,15 +607,20 @@ class ProcessReplica(Replica):
                     # fail the batch typed, keep serving (the thread
                     # path's dispatch_batch contract)
                     self.metrics.record_error()
+                    if tracer is not None:
+                        tracer.batch_end(batch, bsid, ok=False)
                     with self._lock:
                         self._inflight = []
                     for _, fut in batch:
                         if fut.set_error(e):
                             self.metrics.record_failed(fut.cls)
+                            self._finish_trace(fut, "failed")
                     continue
                 self.metrics.record_service(
                     time.monotonic() - t0, len(batch)
                 )
+                if tracer is not None:
+                    tracer.batch_end(batch, bsid)
                 for (_, fut), row in zip(batch, np.asarray(logits)):
                     if fut.set_result(row):
                         self.metrics.record_request_done(
@@ -584,6 +628,7 @@ class ProcessReplica(Replica):
                             within_deadline=fut.within_deadline,
                         )
                         self._note_done(fut)
+                        self._finish_trace(fut, "completed")
                 with self._lock:
                     self._inflight = []
                     self.dispatches += 1
@@ -600,8 +645,14 @@ class ProcessReplica(Replica):
             self._sup.request_stop("dispatcher closed")
         client = self._client or self._try_connect_quick()
         if client is not None:
+            tracer = getattr(self.queue, "tracer", None)
             try:
-                reply = client.drain()
+                reply = client.drain(
+                    trace_flush=(
+                        tracer.take_flush(self.rid)
+                        if tracer is not None else None
+                    )
+                )
                 self._engine_stats = reply.get("stats") or self._engine_stats
             except FleetTransportError:
                 pass

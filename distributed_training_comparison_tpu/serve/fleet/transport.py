@@ -23,12 +23,18 @@ semantic stays exactly where PR 14 put it.
 ``submit``            body = one coalesced batch (C-order ndarray bytes,
                       shape/dtype in the header); reply ``result`` with
                       the logits as body, or ``error`` (typed name +
-                      message, no body)
+                      message, no body).  An optional ``trace`` header
+                      field carries per-request trace context
+                      (``obs/reqtrace``) — a worker that does not know
+                      the field behaves exactly as before, so the
+                      extension is backward-compatible on the wire
 ``health``            liveness probe; reply carries pid, state,
                       dispatches, and the worker's beat age
 ``drain``             finish the in-flight dispatch, ack, then exit 0 —
                       the deliberate drain (supervisor does not restart
-                      a clean exit)
+                      a clean exit).  Optional ``trace_flush`` header
+                      field: trace ids whose buffered device spans the
+                      worker should emit before acking
 ``stats``             the engine's counter dict (compiles / cache hits /
                       bucket counts)
 ``shutdown``          ack then exit 0 without draining (close path)
@@ -210,9 +216,17 @@ class ReplicaClient:
 
     # -- typed ops ------------------------------------------------------
 
-    def submit_batch(self, images: np.ndarray) -> np.ndarray:
+    def submit_batch(
+        self, images: np.ndarray, *, trace=None
+    ) -> np.ndarray:
         meta, body = encode_array(images)
-        reply, rbody = self.rpc({"op": "submit", **meta}, body)
+        header = {"op": "submit", **meta}
+        if trace:
+            # optional trace context (obs/reqtrace.wire_header) — a
+            # worker that does not know the field ignores it, so old
+            # and new peers interoperate in both directions
+            header["trace"] = trace
+        reply, rbody = self.rpc(header, body)
         if reply.get("op") == "error":
             # the worker survived but the dispatch failed (engine error):
             # surface it typed so the batch fails without killing the
@@ -230,8 +244,14 @@ class ReplicaClient:
         reply, _ = self.rpc({"op": "stats"})
         return reply.get("stats", {})
 
-    def drain(self) -> dict:
-        reply, _ = self.rpc({"op": "drain"})
+    def drain(self, *, trace_flush=None) -> dict:
+        header: dict = {"op": "drain"}
+        if trace_flush:
+            # trace ids whose tail-keep decision landed after their last
+            # dispatch: the worker emits their buffered device spans
+            # before acking (same wire-compat rule as "trace")
+            header["trace_flush"] = list(trace_flush)
+        reply, _ = self.rpc(header)
         return reply
 
     def shutdown(self) -> None:

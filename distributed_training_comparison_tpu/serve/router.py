@@ -163,6 +163,13 @@ class Replica:
                 queue_depth=self.queue.depth,
             )
 
+    def _finish_trace(self, fut, outcome: str) -> None:
+        """Report a terminal outcome this replica decided to the shared
+        queue's request tracer (when one is attached)."""
+        tracer = getattr(self.queue, "tracer", None)
+        if tracer is not None:
+            tracer.finish(fut, outcome)
+
     def _note_done(self, fut) -> None:
         """Fold one completed future into this replica's per-class
         latency sample (bounded: newest 2048 per class)."""
@@ -236,6 +243,7 @@ class Replica:
                     )
                 ):
                     self.metrics.record_failed(fut.cls)
+                    self._finish_trace(fut, "failed")
             if not batch:
                 break
             # beat NOW so the health timeout clocks this dispatch alone
@@ -245,7 +253,10 @@ class Replica:
             # above the worst-case single dispatch INCLUDING a compile —
             # see ServeRouter's docstring
             self._beat()
-            for fut in dispatch_batch(self.engine, batch, self.metrics):
+            for fut in dispatch_batch(
+                self.engine, batch, self.metrics,
+                tracer=self.queue.tracer, rid=self.rid,
+            ):
                 self._note_done(fut)
             with self._lock:
                 self._inflight = []
@@ -291,6 +302,7 @@ class Replica:
                 )
             ):
                 self.metrics.record_failed(fut.cls)
+                self._finish_trace(fut, "failed")
                 failed += 1
         if self.bus is not None:
             self.bus.emit(
@@ -361,6 +373,7 @@ class ServeRouter:
         monitor=None,
         transport: str = "thread",
         process_spec: dict | None = None,
+        tracer=None,
     ) -> None:
         if replicas < 1:
             raise ValueError(f"router needs >= 1 replica, got {replicas}")
@@ -382,7 +395,8 @@ class ServeRouter:
             registry=registry, classes=self.classes
         )
         self.queue = ClassQueue(
-            classes=self.classes, limit=queue_limit, metrics=self.metrics
+            classes=self.classes, limit=queue_limit, metrics=self.metrics,
+            tracer=tracer,
         )
         self.bus = bus
         self.registry = registry
@@ -450,8 +464,13 @@ class ServeRouter:
     def attach_autoscaler(self, autoscaler) -> None:
         """Wire the queueing-aware autoscaler into the ticker: one
         sizing step per ``_scale_every_s`` (it carries its own cooldown
-        and hysteresis)."""
+        and hysteresis).  The router's request tracer (when present)
+        becomes the scaler's measured-wait ground truth — every
+        ``serve_scale`` decision then records ``wait_measured_s`` from
+        kept traces next to its Sakasegawa ``wait_modeled_s``."""
         self.autoscaler = autoscaler
+        if getattr(autoscaler, "tracer", None) is None:
+            autoscaler.tracer = self.queue.tracer
 
     def start(self) -> "ServeRouter":
         for r in self.replicas:
@@ -710,6 +729,19 @@ class ServeRouter:
             r.join(timeout)
         self._closed = True
         self.emit_route_event(final=True)
+        if self.transport == "process" and self.process_spec:
+            # gather every replica process's SIGKILL-surviving flight
+            # ring (the workers attach them under the fleet dir) into
+            # blackbox.json — a killed worker's last seconds are part of
+            # the run's forensics, same as a killed training host's
+            events_dir = self.process_spec.get("events_dir")
+            if events_dir:
+                from .. import obs
+
+                try:
+                    obs.collect_black_box(events_dir)
+                except OSError:
+                    pass
 
     def __enter__(self) -> "ServeRouter":
         return self

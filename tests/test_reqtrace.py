@@ -1,0 +1,735 @@
+"""Request tracing: context propagation, tail-based keep, the worker
+span ring, and the ``run_report --trace`` merge.
+
+What is pinned here and why:
+
+- **Tail-keep decisions** — the whole value of the rail is that the
+  traces an operator greps for (shed / expired / breached / requeued /
+  errored) ALWAYS exist at sampling 0, and healthy requests cost only
+  context stamps.  Each outcome is driven end-to-end through a real
+  ``MicroBatcher`` and asserted against the emitted ``trace`` events.
+- **One trace across a requeue** — the kill-requeue contract: the failed
+  attempt's span names the dead replica with a ``requeued`` annotation,
+  the retry names the survivor, one ``trace_id`` spans both.
+- **The report merge** — ``--trace`` joins router span trees with worker
+  device spans across event files, stars the widest p95 segment, skips
+  torn records, and exits 1 exactly when a deadlined class breached with
+  zero kept traces.
+- **Satellites** — the worker ring's eager/flush/dedupe protocol, the
+  fleet-dir flight rings reaching ``collect_black_box``, the autoscaler's
+  measured-vs-modeled wait fields, and ``--diff``'s '-' (never 0) for
+  absent segments.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import run_report  # noqa: E402
+
+from distributed_training_comparison_tpu import obs
+from distributed_training_comparison_tpu.obs import (
+    EventBus,
+    MmapRing,
+    RequestTracer,
+    WorkerTraceRing,
+    collect_black_box,
+    find_rings,
+    ring_filename,
+)
+from distributed_training_comparison_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    QueueOverflow,
+    ServeFuture,
+)
+
+pytestmark = [pytest.mark.obs, pytest.mark.trace]
+
+
+class _StubEngine:
+    max_bucket = 8
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+
+    def predict_logits(self, imgs):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.zeros((len(imgs), 4), np.float32)
+
+
+def _img():
+    return np.zeros((4, 4, 3), np.uint8)
+
+
+def _trace_events(tmp_path, process_index=None):
+    evs = []
+    for f in run_report.find_event_files(tmp_path):
+        evs.extend(obs.load_events(f))
+    return [
+        e for e in evs
+        if e.get("kind") == "trace"
+        and (process_index is None
+             or e.get("process_index") == process_index)
+    ]
+
+
+# ------------------------------------------------------------ the tracer
+
+
+def test_mint_is_seeded_and_sampling_deterministic():
+    a = RequestTracer(sample_rate=0.5, seed=7)
+    b = RequestTracer(sample_rate=0.5, seed=7)
+    ca = [a.begin("default") for _ in range(32)]
+    cb = [b.begin("default") for _ in range(32)]
+    assert [c.trace_id for c in ca] == [c.trace_id for c in cb]
+    assert [c.sampled for c in ca] == [c.sampled for c in cb]
+    assert len({c.trace_id for c in ca}) == 32
+    # a different seed decorrelates
+    c = RequestTracer(sample_rate=0.5, seed=8)
+    assert [x.sampled for x in (c.begin("default") for _ in range(32))] != [
+        x.sampled for x in ca
+    ]
+
+
+def test_sample_rate_validated():
+    with pytest.raises(ValueError):
+        RequestTracer(sample_rate=1.5)
+    with pytest.raises(ValueError):
+        RequestTracer(sample_rate=-0.1)
+
+
+def test_wire_header_rows_align_and_carry_keep_flags():
+    tr = RequestTracer(sample_rate=0.0, seed=0)
+    futs = []
+    for keep in (False, True, None):
+        fut = ServeFuture(time.monotonic(), None, cls="default")
+        if keep is None:
+            fut.trace = None  # an untraced request in a traced batch
+        else:
+            fut.trace = tr.begin("default")
+            fut.trace.keep = keep
+        futs.append(fut)
+    batch = [(_img(), f) for f in futs]
+    bsid = tr.batch_begin(batch, 3)
+    hdr = tr.wire_header(batch, bsid, 3)
+    assert hdr["batch"] == bsid
+    assert len(hdr["reqs"]) == 3
+    assert hdr["reqs"][0][1] == 0
+    assert hdr["reqs"][1][1] == 1
+    assert hdr["reqs"][2] is None
+    # pending flush ids for this worker ride the same header, once
+    tr.request_flush(3, "cafecafecafecafe")
+    hdr2 = tr.wire_header(batch, bsid, 3)
+    assert hdr2["flush"] == ["cafecafecafecafe"]
+    assert "flush" not in tr.wire_header(batch, bsid, 3)
+    # the header is what the frame codec will see: JSON-safe
+    json.dumps(hdr2)
+
+
+def test_finish_is_idempotent_first_outcome_wins():
+    tr = RequestTracer(sample_rate=1.0, seed=0)
+    ctx = tr.begin("default")
+    tr.finish_ctx(ctx, "shed")
+    tr.finish_ctx(ctx, "completed")
+    assert tr.kept == 1
+    assert tr.kept_by_reason == {"shed": 1}
+
+
+# -------------------------------------------- tail-keep through a batcher
+
+
+def test_healthy_requests_at_sample_zero_keep_nothing(tmp_path):
+    bus = EventBus(run_id="a" * 16)
+    bus.bind_dir(tmp_path)
+    tr = RequestTracer(bus=bus, sample_rate=0.0, seed=0)
+    with MicroBatcher(
+        _StubEngine(), max_wait_ms=1, queue_limit=32, tracer=tr
+    ) as b:
+        futs = [b.submit(_img()) for _ in range(6)]
+        for f in futs:
+            f.result(timeout=5)
+    bus.close()
+    assert _trace_events(tmp_path) == []
+    assert tr.dropped == 6 and tr.kept == 0
+
+
+def test_sampled_trace_has_the_full_span_tree(tmp_path):
+    bus = EventBus(run_id="b" * 16)
+    bus.bind_dir(tmp_path)
+    tr = RequestTracer(bus=bus, sample_rate=1.0, seed=0)
+    with MicroBatcher(
+        _StubEngine(delay_s=0.01), max_wait_ms=1, queue_limit=32, tracer=tr
+    ) as b:
+        b.submit(_img()).result(timeout=5)
+    bus.close()
+    (ev,) = _trace_events(tmp_path)
+    p = ev["payload"]
+    assert p["keep"] == "sampled" and p["outcome"] == "completed"
+    names = [s["name"] for s in p["spans"]]
+    for expected in ("request", "admit", "queue", "batch", "device",
+                     "reply"):
+        assert expected in names, f"missing span {expected} in {names}"
+    # the thread transport measures the engine inline: device, no rpc
+    assert "rpc" not in names
+    dev = next(s for s in p["spans"] if s["name"] == "device")
+    assert dev["dur_s"] >= 0.01
+
+
+def test_shed_and_expired_and_breach_kept_at_sample_zero(tmp_path):
+    bus = EventBus(run_id="c" * 16)
+    bus.bind_dir(tmp_path)
+    tr = RequestTracer(bus=bus, sample_rate=0.0, seed=0)
+    eng = _StubEngine(delay_s=0.15)
+    b = MicroBatcher(eng, max_wait_ms=1, queue_limit=4, tracer=tr)
+    try:
+        # breach: taken instantly from an empty queue, completes late
+        breached = b.submit(_img(), deadline_ms=10.0)
+        time.sleep(0.05)  # its batch is now in the engine
+        # expired: dies in the queue behind the slow dispatch
+        doomed = b.submit(_img(), deadline_ms=1.0)
+        # shed: overflow the bounded queue behind the busy worker
+        with pytest.raises(QueueOverflow):
+            for _ in range(12):
+                b.submit(_img())
+        assert breached.result(timeout=5) is not None
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=5)
+    finally:
+        b.close()
+    bus.close()
+    reasons = {
+        e["payload"]["keep"] for e in _trace_events(tmp_path)
+    }
+    assert "shed" in reasons
+    assert "expired" in reasons
+    assert "deadline_breach" in reasons
+    breach_ev = next(
+        e for e in _trace_events(tmp_path)
+        if e["payload"]["keep"] == "deadline_breach"
+    )
+    assert breach_ev["payload"]["breach"] is True
+    assert breach_ev["payload"]["outcome"] == "completed"
+
+
+def test_requeued_request_keeps_one_trace_across_replicas():
+    tr = RequestTracer(sample_rate=0.0, seed=0)
+    emitted = []
+    tr.bus = type("B", (), {"emit": lambda self, k, **p: emitted.append(p)})()
+    fut = ServeFuture(time.monotonic(), None, cls="default")
+    fut.trace = tr.begin("default")
+    tr.enqueued(fut.trace)
+    fut.trace.t_taken = time.monotonic()
+    batch = [(_img(), fut)]
+    # attempt 1 on replica 0 dies mid-dispatch
+    b0 = tr.batch_begin(batch, 0)
+    tr.batch_end(batch, b0, ok=False, requeued=True)
+    tr.mark_requeued(fut)
+    # attempt 2 on replica 1 succeeds
+    b1 = tr.batch_begin(batch, 1)
+    tr.batch_end(batch, b1)
+    fut.set_result(np.zeros(4))
+    tr.finish(fut, "completed")
+    (p,) = emitted
+    assert p["keep"] == "requeued" and p["requeues"] == 1
+    rpcs = [s for s in p["spans"] if s["name"] == "rpc"]
+    assert [s["rid"] for s in rpcs] == [0, 1]
+    assert rpcs[0].get("requeued") is True and rpcs[0].get("ok") is False
+    assert "requeued" not in rpcs[1] and "ok" not in rpcs[1]
+    # both batch spans present, reply hangs off the surviving attempt
+    assert [s["name"] for s in p["spans"]].count("batch") == 2
+    reply = next(s for s in p["spans"] if s["name"] == "reply")
+    assert reply["parent"] == b1
+
+
+def test_failed_batch_keeps_trace_with_failed_reason(tmp_path):
+    class _Broken(_StubEngine):
+        def predict_logits(self, imgs):
+            raise RuntimeError("engine on fire")
+
+    bus = EventBus(run_id="d" * 16)
+    bus.bind_dir(tmp_path)
+    tr = RequestTracer(bus=bus, sample_rate=0.0, seed=0)
+    with MicroBatcher(
+        _Broken(), max_wait_ms=1, queue_limit=8, tracer=tr
+    ) as b:
+        fut = b.submit(_img())
+        with pytest.raises(RuntimeError):
+            fut.result(timeout=5)
+    bus.close()
+    (ev,) = _trace_events(tmp_path)
+    assert ev["payload"]["keep"] == "failed"
+    rpc = next(
+        s for s in ev["payload"]["spans"]
+        if s["name"] in ("rpc", "device")
+    )
+    assert rpc.get("ok") is False
+
+
+def test_kept_traces_feed_the_wait_reservoir():
+    tr = RequestTracer(sample_rate=1.0, seed=0)
+    for wait in (0.01, 0.02, 0.03):
+        ctx = tr.begin("default")
+        ctx.t_enq = 100.0
+        ctx.t_taken = 100.0 + wait
+        tr.finish_ctx(ctx, "completed")
+    stats = tr.queue_wait_stats()
+    assert stats["n"] == 3
+    assert 0.01 <= stats["p50"] <= 0.03
+    assert abs(stats["mean"] - 0.02) < 1e-9
+
+
+# ------------------------------------------------------- the worker ring
+
+
+class _RecBus:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **payload):
+        self.events.append((kind, payload))
+
+
+def test_worker_ring_eager_emit_then_flush_dedupes():
+    bus = _RecBus()
+    ring = WorkerTraceRing(bus, replica=2, slots=8)
+    hdr = {"reqs": [["aaaa", 0], ["bbbb", 1], None], "batch": "b1"}
+    ring.record(hdr, t0_wall=123.0, dur_s=0.05, n=3)
+    # keep-now row emitted eagerly, the tail-pending one buffered
+    assert len(bus.events) == 1
+    kind, p = bus.events[0]
+    assert kind == "trace" and p["trace_ids"] == ["bbbb"]
+    assert p["span"]["rid"] == 2 and p["span"]["batch"] == "b1"
+    # retro-flush emits the buffered id once; the eager one never again
+    assert ring.flush(["aaaa", "bbbb"]) == 1
+    assert bus.events[1][1]["trace_ids"] == ["aaaa"]
+    assert ring.flush(["aaaa", "bbbb"]) == 0
+
+
+def test_worker_ring_flush_rides_the_next_submit_header():
+    bus = _RecBus()
+    ring = WorkerTraceRing(bus, replica=0, slots=8)
+    ring.record({"reqs": [["t1", 0]], "batch": "b1"}, 1.0, 0.01, 1)
+    assert bus.events == []  # nothing kept yet
+    # the next frame piggybacks the router's tail-keep decision
+    ring.record(
+        {"reqs": [["t2", 0]], "batch": "b2", "flush": ["t1"]},
+        2.0, 0.01, 1,
+    )
+    assert [p["trace_ids"] for _, p in bus.events] == [["t1"]]
+
+
+def test_worker_ring_is_bounded():
+    bus = _RecBus()
+    ring = WorkerTraceRing(bus, replica=0, slots=4)
+    for i in range(16):
+        ring.record({"reqs": [[f"t{i}", 0]], "batch": f"b{i}"}, i, 0.01, 1)
+    # only the newest 4 remain flushable
+    assert ring.flush([f"t{i}" for i in range(16)]) == 4
+
+
+# ----------------------------------------------- blackbox fleet-dir rings
+
+
+def test_find_rings_includes_fleet_subdir_and_blackbox_collects(tmp_path):
+    root = tmp_path
+    fleet = root / "serve-fleet"
+    # incarnation 1 of replica process 1+rid=2: the restart-safe name
+    name = ring_filename(1, 2)
+    assert name == "flight-a1-p2.ring"
+    ring = MmapRing(fleet / name, slots=8)
+    ring.append(json.dumps({
+        "v": 1, "kind": "trace", "t_wall": 5.0, "t_mono": 1.0,
+        "payload": {"trace_ids": ["dead"], "span": {"name": "device"}},
+    }))
+    ring.close()
+    top = MmapRing(root / ring_filename(0, 0), slots=8)
+    top.append(json.dumps({"v": 1, "kind": "run_start", "t_wall": 1.0}))
+    top.close()
+    found = find_rings(root)
+    assert fleet / name in found and root / "flight.ring" in found
+    out = collect_black_box(root)
+    report = json.loads(Path(out).read_text())
+    rel = f"serve-fleet/{name}"
+    assert rel in report["rings"]
+    assert report["rings"][rel]["last_kinds"] == ["trace"]
+    # the dead worker's final emits are in the merged timeline
+    assert any(e.get("kind") == "trace" for e in report["events"])
+
+
+# --------------------------------------------------- autoscaler wait rows
+
+
+class _FlatMetrics:
+    classes = {"default": None}
+
+    def arrival_stats(self, window_s):
+        return {"lam_rps": 10.0, "ca2": 1.0}
+
+    def service_stats(self):
+        return {
+            "n": 64, "mean_s": 0.01, "cv2": 1.0, "p99_s": 0.02,
+            "mean_batch": 2.0,
+        }
+
+
+def test_autoscaler_decision_carries_modeled_and_measured_wait():
+    from distributed_training_comparison_tpu.serve.fleet.autoscale import (
+        Autoscaler,
+    )
+
+    tr = RequestTracer(sample_rate=1.0, seed=0)
+    ctx = tr.begin("default")
+    ctx.t_enq, ctx.t_taken = 10.0, 10.25
+    tr.finish_ctx(ctx, "completed")
+    sc = Autoscaler(_FlatMetrics(), {"*": 0.4}, bus=None, tracer=tr)
+    d = sc.evaluate(current=1)
+    assert d["wait_modeled_s"] is not None and d["wait_modeled_s"] >= 0
+    assert d["wait_measured_s"]["n"] == 1
+    assert abs(d["wait_measured_s"]["p50"] - 0.25) < 1e-9
+    # no tracer -> the field is honest about having no measurement
+    d2 = Autoscaler(_FlatMetrics(), {"*": 0.4}, bus=None).evaluate(1)
+    assert d2["wait_measured_s"] is None
+
+
+# --------------------------------------------------- the --trace report
+
+
+def _emit_synthetic_run(tmp_path, *, with_traces=True, breaches=2):
+    """A run root with serve_route counters and (optionally) kept
+    traces: router file at process 0, worker device spans at process 1."""
+    router = EventBus(run_id="e" * 16, attempt=0, process_index=0)
+    router.bind_dir(tmp_path)
+    router.emit(
+        "serve_route",
+        router="r0",
+        classes={
+            "gold": {
+                "priority": 0, "deadline_ms": 250.0, "target": 0.99,
+                "completed": 5, "ok_deadline": 5 - breaches,
+                "expired": 0, "shed": 0, "failed": 0,
+            }
+        },
+    )
+    if with_traces:
+        for i in range(breaches):
+            router.emit(
+                "trace",
+                trace_id=f"t{i}", cls="gold", keep="deadline_breach",
+                sampled=False, outcome="completed", breach=True,
+                requeues=0, deadline_ms=250.0,
+                spans=[
+                    {"name": "request", "span_id": "r", "parent": None,
+                     "t0_wall": 100.0, "dur_s": 0.5},
+                    {"name": "admit", "parent": "r", "t0_wall": 100.0,
+                     "dur_s": 0.001},
+                    {"name": "queue", "parent": "r", "t0_wall": 100.001,
+                     "dur_s": 0.4},
+                    {"name": "batch", "span_id": "b1", "parent": "r",
+                     "t0_wall": 100.401, "dur_s": 0.098, "n": 2, "rid": 0},
+                    {"name": "coalesce", "parent": "b1",
+                     "t0_wall": 100.401, "dur_s": 0.002},
+                    {"name": "rpc", "parent": "b1", "rid": 0,
+                     "t0_wall": 100.403, "dur_s": 0.09},
+                    {"name": "reply", "parent": "b1",
+                     "t0_wall": 100.493, "dur_s": 0.001},
+                ],
+            )
+    router.close()
+    if with_traces:
+        worker = EventBus(run_id="e" * 16, attempt=0, process_index=1)
+        worker.bind_dir(tmp_path)
+        worker.emit(
+            "trace",
+            trace_ids=[f"t{i}" for i in range(breaches)],
+            span={"name": "device", "t0_wall": 100.41, "dur_s": 0.08,
+                  "batch": "b1", "rid": 0, "n": 2},
+        )
+        worker.close()
+
+
+def test_trace_report_merges_worker_spans_and_stars_widest(tmp_path):
+    _emit_synthetic_run(tmp_path, with_traces=True)
+    lines = []
+    rc = run_report.trace_report(tmp_path, out=lines.append)
+    assert rc == 0
+    text = "\n".join(lines)
+    assert "class gold" in text
+    queue_line = next(l for l in lines if l.strip().startswith("queue"))
+    assert "*widest" in queue_line  # 400ms queue dominates
+    device_line = next(l for l in lines if l.strip().startswith("device"))
+    assert "80" in device_line  # the worker span crossed the file join
+    hop_line = next(l for l in lines if l.strip().startswith("hop"))
+    assert "10" in hop_line  # rpc 90ms - device 80ms
+
+
+def test_trace_report_exits_1_on_breaches_without_traces(tmp_path):
+    _emit_synthetic_run(tmp_path, with_traces=False)
+    lines = []
+    rc = run_report.trace_report(tmp_path, out=lines.append)
+    assert rc == 1
+    assert any("NO TRACES FOR BREACHED CLASS" in l for l in lines)
+
+
+def test_trace_report_skips_torn_record_keeps_survivors(tmp_path):
+    _emit_synthetic_run(tmp_path, with_traces=True)
+    # simulate a writer killed mid-record: a torn JSON tail on the
+    # router's file (no newline), as after truncation/rotation
+    f = tmp_path / "events.jsonl"
+    with open(f, "ab") as fh:
+        fh.write(b'{"v": 1, "kind": "trace", "payload": {"trace_id": "tor')
+    lines = []
+    rc = run_report.trace_report(tmp_path, out=lines.append)
+    assert rc == 0
+    assert any("kept traces: 2" in l for l in lines)
+
+
+def test_event_tailer_buffers_torn_tail_until_completed(tmp_path):
+    f = tmp_path / "events.jsonl"
+    whole = json.dumps({"v": 1, "kind": "trace", "t_wall": 1.0})
+    torn = json.dumps({"v": 1, "kind": "trace", "t_wall": 2.0})
+    f.write_text(whole + "\n" + torn[:10])
+    tailer = obs.EventTailer(tmp_path)
+    first = tailer.poll()
+    assert [e["t_wall"] for e in first] == [1.0]
+    with open(f, "a") as fh:
+        fh.write(torn[10:] + "\n")
+    second = tailer.poll()
+    assert [e["t_wall"] for e in second] == [2.0]
+
+
+def test_diff_renders_dash_for_absent_segments(tmp_path):
+    a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+    _emit_synthetic_run(a_dir, with_traces=True)
+    _emit_synthetic_run(b_dir, with_traces=False)
+    a, _ = run_report.load_run(a_dir)
+    b, _ = run_report.load_run(b_dir)
+    text = run_report.format_diff(
+        "a", run_report.summarize(a), "b", run_report.summarize(b)
+    )
+    rows = {
+        l.split("  ")[0].strip(): l for l in text.splitlines()
+        if l.startswith("gold")
+    }
+    assert "gold queue p95 ms" in text
+    queue_row = next(
+        l for l in text.splitlines() if l.startswith("gold queue")
+    )
+    # run A measured ~400ms, run B kept nothing: number vs '-' — and the
+    # delta of a missing side is '-' too, never a fabricated 0
+    assert "400.0" in queue_row and "-" in queue_row
+    assert rows  # the per-class rows exist at all
+
+
+def test_trace_diff_cells_never_fabricate_zero(tmp_path):
+    _emit_synthetic_run(tmp_path, with_traces=True)
+    events, _ = run_report.load_run(tmp_path)
+    cells = run_report.trace_diff_cells(events)
+    assert cells["gold"]["n"] == 2
+    assert abs(cells["gold"]["queue_p95_ms"] - 400.0) < 1.0
+    assert abs(cells["gold"]["device_p95_ms"] - 80.0) < 1.0
+    assert abs(cells["gold"]["transport_p95_ms"] - 10.0) < 1.0
+    # a thread-transport run: no hop measured, cell absent not 0
+    thread_dir = tmp_path / "thread"
+    bus = EventBus(run_id="f" * 16)
+    bus.bind_dir(thread_dir)
+    bus.emit(
+        "trace", trace_id="x", cls="gold", keep="sampled", sampled=True,
+        outcome="completed", breach=False, requeues=0, deadline_ms=None,
+        spans=[
+            {"name": "request", "span_id": "r", "parent": None,
+             "t0_wall": 1.0, "dur_s": 0.1},
+            {"name": "device", "parent": "b", "rid": 0, "t0_wall": 1.0,
+             "dur_s": 0.05},
+        ],
+    )
+    bus.close()
+    tevents, _ = run_report.load_run(thread_dir)
+    tcells = run_report.trace_diff_cells(tevents)
+    assert tcells["gold"]["transport_p95_ms"] is None
+    assert tcells["gold"]["queue_p95_ms"] is None
+
+
+# ------------------------------------ the REAL process fleet (slow e2e)
+
+
+def _process_router(tmp_path, tracer):
+    from test_serve_fleet import _bus
+    from test_serve_process import _process_spec
+
+    from distributed_training_comparison_tpu.serve import ServeRouter
+
+    bus = _bus(tmp_path)
+    spec = _process_spec(tmp_path)
+    r = ServeRouter(
+        None, replicas=1, transport="process", process_spec=spec,
+        bus=bus, queue_limit=64, emit_every_s=0.5, tracer=tracer,
+    )
+    return bus, r
+
+
+@pytest.mark.slow
+@pytest.mark.serve_fleet
+def test_process_fleet_sampled_traces_cross_the_wire(tmp_path):
+    """Sample 1.0 on a real worker process: the device span is emitted
+    eagerly from the worker's own bus (events-p1.jsonl) and the report
+    merge reassembles the full tree, hop included, across files."""
+    bus = EventBus(run_id="ab" * 8)
+    bus.bind_dir(tmp_path)
+    tracer = RequestTracer(bus=bus, sample_rate=1.0, seed=0)
+    bus2, r = _process_router(tmp_path, tracer)
+    try:
+        assert r.wait_ready(n=1, timeout=600)
+        img16 = np.zeros((16, 16, 3), np.uint8)
+        for f in [r.submit(img16) for _ in range(4)]:
+            f.result(timeout=120)
+    finally:
+        r.close()
+    bus.close()
+    assert (tmp_path / "events-p1.jsonl").exists()
+    worker_traces = _trace_events(tmp_path, process_index=1)
+    assert worker_traces, "worker never emitted a device span"
+    events, _ = run_report.load_run(tmp_path)
+    rows = run_report.trace_rows(events)
+    assert len(rows) == 4
+    for row in rows:
+        assert row["keep"] == "sampled" and row["outcome"] == "completed"
+        seg = row["segments"]
+        # end-to-end reconstruction from event files alone
+        for name in ("admit", "queue", "device", "reply"):
+            assert seg.get(name) is not None, (name, seg)
+        assert seg["device"] > 0 and seg["hop"] >= 0
+    lines = []
+    assert run_report.trace_report(tmp_path, out=lines.append) == 0
+    assert any("*widest" in l for l in lines)
+
+
+@pytest.mark.slow
+@pytest.mark.serve_fleet
+def test_process_fleet_breach_retro_flushes_device_span(tmp_path):
+    """At sampling 0 a deadline-breached request is STILL fully
+    reconstructable: the worker buffered its device span in the ring
+    and the router's tail-keep decision flushed it over the next frame
+    (or the drain), so the merge finds every segment after the fact."""
+    bus = EventBus(run_id="cd" * 8)
+    bus.bind_dir(tmp_path)
+    tracer = RequestTracer(bus=bus, sample_rate=0.0, seed=0)
+    bus2, r = _process_router(tmp_path, tracer)
+    try:
+        assert r.wait_ready(n=1, timeout=600)
+        img16 = np.zeros((16, 16, 3), np.uint8)
+        # probe the warm latency, then set a deadline half of it: the
+        # first pop happens from an empty queue (so never queue-expired)
+        # and completes late — a breach with a dispatched batch
+        t0 = time.monotonic()
+        r.submit(img16).result(timeout=120)
+        probe_ms = (time.monotonic() - t0) * 1e3
+        deadline_ms = max(2.0, probe_ms * 0.5)
+        futs = [r.submit(img16, deadline_ms=deadline_ms)
+                for _ in range(8)]
+        for f in futs:
+            try:
+                f.result(timeout=120)
+            except DeadlineExceeded:
+                pass  # queue-expired stragglers are kept too
+    finally:
+        r.close()
+    bus.close()
+    events, _ = run_report.load_run(tmp_path)
+    rows = run_report.trace_rows(events)
+    reasons = {row["keep"] for row in rows}
+    assert reasons <= {"deadline_breach", "expired"}
+    breached = [r_ for r_ in rows if r_["keep"] == "deadline_breach"]
+    assert breached, f"no breach kept (probe {probe_ms:.1f}ms): {reasons}"
+    # the probe request itself was healthy at sample 0: not kept
+    assert len(rows) <= 8
+    dev = [r_ for r_ in breached
+           if r_["segments"].get("device") is not None]
+    assert dev, "retro-flush never delivered the worker device span"
+    assert run_report.trace_report(tmp_path, out=lambda s: None) == 0
+
+
+@pytest.mark.slow
+@pytest.mark.serve_fleet
+def test_process_fleet_kill_requeue_keeps_one_trace(tmp_path):
+    """SIGKILL a worker mid-dispatch on a 2-replica fleet: the rescued
+    request keeps ONE trace spanning both replicas — the failed attempt
+    annotated ``requeued`` on the dead rid, the retry on the survivor."""
+    import os
+    import signal
+
+    from test_serve_fleet import _bus, _wait
+    from test_serve_process import _process_spec
+
+    from distributed_training_comparison_tpu.serve import ServeRouter
+
+    bus = _bus(tmp_path)
+    spec = _process_spec(tmp_path, buckets=(1, 2), image_size=32)
+    tracer = RequestTracer(bus=bus, sample_rate=0.0, seed=0)
+    r = ServeRouter(
+        None, replicas=2, transport="process", process_spec=spec,
+        bus=bus, queue_limit=512, emit_every_s=0.5, tracer=tracer,
+    )
+    try:
+        assert r.wait_ready(n=2, timeout=600)
+        rep = r.replicas[0]
+        pid = rep.pid
+        img32 = np.zeros((32, 32, 3), np.uint8)
+        futs = [r.submit(img32) for _ in range(64)]
+        _wait(lambda: rep.dispatches >= 2, timeout=120,
+              what="dispatches flowing")
+        os.kill(pid, signal.SIGKILL)
+        rows = [f.result(timeout=600) for f in futs]
+        assert len(rows) == 64
+    finally:
+        r.close()
+    events, _ = run_report.load_run(tmp_path)
+    trows = run_report.trace_rows(events)
+    requeued = [t for t in trows if t["keep"] == "requeued"]
+    assert requeued, "kill-requeued request kept no trace"
+    row = requeued[0]
+    assert row["requeues"] >= 1 and row["outcome"] == "completed"
+    # one trace_id, two replica attempts visible in its rid trail
+    ev = next(
+        e["payload"] for e in events
+        if e.get("kind") == "trace"
+        and e["payload"].get("trace_id") == row["trace_id"]
+    )
+    rpcs = [s for s in ev["spans"] if s["name"] == "rpc"]
+    assert any(s.get("requeued") for s in rpcs), rpcs
+    assert any(s.get("ok", True) and not s.get("requeued")
+               for s in rpcs), rpcs
+
+
+# ------------------------------------------------------- config + kinds
+
+
+def test_serve_trace_sample_flag_parses_and_validates():
+    from distributed_training_comparison_tpu.config import load_config
+
+    hp = load_config("tpu", argv=["--serve-trace-sample", "0.25"])
+    assert hp.serve_trace_sample == 0.25
+    assert load_config("tpu", argv=[]).serve_trace_sample == 0.0
+    with pytest.raises(SystemExit):
+        load_config("tpu", argv=["--serve-trace-sample", "1.5"])
+    with pytest.raises(SystemExit):
+        load_config("tpu", argv=["--serve-trace-sample", "-0.1"])
+
+
+def test_trace_kind_is_registered():
+    assert "trace" in obs.KNOWN_KINDS
+    ev = EventBus(run_id="9" * 16).emit(
+        "trace", trace_id="t", cls="gold", keep="sampled", sampled=True,
+        outcome="completed", breach=False, requeues=0, deadline_ms=None,
+        spans=[],
+    )
+    assert not obs.validate_event(ev)

@@ -23,6 +23,12 @@ Usage::
                                                       # attainment table; rc=1
                                                       # on any class below its
                                                       # target
+    python tools/run_report.py CKPT_ROOT --trace      # per-SLO-class request
+                                                      # critical-path table
+                                                      # from kept traces; rc=1
+                                                      # when a deadlined class
+                                                      # breached with zero
+                                                      # kept traces
     python tools/run_report.py CKPT_ROOT --export-openmetrics [OUT]
                                                       # offline scrape render
     python tools/run_report.py CKPT_ROOT --xplane OUT.json \\
@@ -405,6 +411,9 @@ def summarize(events: list[dict]) -> dict:
         # the per-executable compile/cost/memory fold (PR 8) — --compute
         # renders it; --diff compares its totals across runs
         "compute": compute_summary(events),
+        # per-class trace-segment p95s from kept request traces — the
+        # --diff rows; {} when the run kept no traces
+        "trace_classes": trace_diff_cells(events),
         "events": len(events),
         "rollbacks": sum(a["rollbacks"] for a in attempts.values()),
         "epochs": sum(a["epochs"] for a in attempts.values()),
@@ -1210,6 +1219,226 @@ def serve_report(path: str | Path, out=print) -> int:
     return rc
 
 
+# ------------------------------------------------------------------- trace
+#
+# Request tracing (obs/reqtrace.py): the router emits one `trace` event
+# per KEPT trace (the span tree), each replica process emits per-batch
+# device spans on its OWN bus keyed by trace_id.  load_run already
+# merged the files and removed clock skew, so joining here is pure
+# dictionary work.
+
+TRACE_SEGMENTS = ("admit", "queue", "coalesce", "hop", "device", "reply")
+
+
+def _quantile(vals: list[float], f: float) -> float:
+    vs = sorted(vals)
+    return vs[min(len(vs) - 1, int(f * len(vs)))]
+
+
+def trace_rows(events: list[dict]) -> list[dict]:
+    """One row per kept trace: class, keep reason, requeue trail, and the
+    critical-path segment durations (seconds).
+
+    Router records (payload carries ``trace_id`` + ``spans``) hold the
+    admission/queue/coalesce/rpc/reply tree; worker records (payload
+    carries ``trace_ids`` + one device ``span``) are joined on
+    ``(trace_id, batch span id)`` to split the final rpc into device
+    time and socket hop.  Thread-transport traces carry their device
+    span inline (no hop — there is no socket).  A segment that was never
+    measured stays ABSENT, never a fabricated zero."""
+    # (trace_id, batch_span_id) -> the worker's device span
+    worker: dict[tuple, dict] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "trace":
+            continue
+        p = _payload(ev)
+        sp = p.get("span")
+        if p.get("trace_ids") and sp:
+            for tid in p["trace_ids"]:
+                worker.setdefault((tid, sp.get("batch")), sp)
+    rows: list[dict] = []
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("kind") != "trace":
+            continue
+        p = _payload(ev)
+        tid = p.get("trace_id")
+        if not tid:
+            continue
+        spans = p.get("spans") or []
+        segments: dict[str, float] = {}
+        for s in spans:
+            if s.get("name") == "admit" and s.get("dur_s") is not None:
+                segments["admit"] = float(s["dur_s"])
+            elif s.get("name") == "queue" and s.get("dur_s") is not None:
+                segments["queue"] = float(s["dur_s"])
+        attempts = [s for s in spans if s.get("name") in ("rpc", "device")]
+        ok_attempts = [s for s in attempts if s.get("ok", True)]
+        if ok_attempts:
+            final = ok_attempts[-1]
+            bsid = final.get("parent")
+            for s in spans:
+                if s.get("parent") != bsid:
+                    continue
+                if s.get("name") == "coalesce":
+                    segments["coalesce"] = float(s.get("dur_s") or 0.0)
+                elif s.get("name") == "reply":
+                    segments["reply"] = float(s.get("dur_s") or 0.0)
+            if final["name"] == "device":
+                # thread transport: the engine ran in-process, the span
+                # IS the device time and there is no hop to measure
+                segments["device"] = float(final.get("dur_s") or 0.0)
+            else:
+                segments["rpc"] = float(final.get("dur_s") or 0.0)
+                dev = worker.get((tid, bsid))
+                if dev is not None:
+                    segments["device"] = float(dev.get("dur_s") or 0.0)
+                    segments["hop"] = max(
+                        0.0, segments["rpc"] - segments["device"]
+                    )
+        rows.append({
+            "trace_id": tid,
+            "cls": p.get("cls") or "default",
+            "keep": p.get("keep"),
+            "outcome": p.get("outcome"),
+            "breach": bool(p.get("breach")),
+            "requeues": int(p.get("requeues") or 0),
+            "rids": [s.get("rid") for s in attempts],
+            "segments": segments,
+        })
+    return rows
+
+
+def trace_class_segments(events: list[dict]) -> dict[str, dict]:
+    """Per-class segment sample lists (seconds) from the kept traces."""
+    per: dict[str, dict] = {}
+    for t in trace_rows(events):
+        cls = per.setdefault(
+            t["cls"], {"n": 0, **{s: [] for s in TRACE_SEGMENTS}}
+        )
+        cls["n"] += 1
+        for seg, v in t["segments"].items():
+            if seg in TRACE_SEGMENTS:
+                cls[seg].append(v)
+    return per
+
+
+def trace_diff_cells(events: list[dict]) -> dict[str, dict]:
+    """The --diff cells: per-class queue-wait / transport / device p95
+    in milliseconds, None (rendered '-') when a segment has no samples —
+    a thread-transport run has no hop, a tail-only run with zero kept
+    traces has nothing, and neither must read as a measured 0."""
+    out: dict[str, dict] = {}
+    for cls, segs in trace_class_segments(events).items():
+        out[cls] = {
+            "n": segs["n"],
+            "queue_p95_ms": (
+                _quantile(segs["queue"], 0.95) * 1000.0
+                if segs["queue"] else None
+            ),
+            "transport_p95_ms": (
+                _quantile(segs["hop"], 0.95) * 1000.0
+                if segs["hop"] else None
+            ),
+            "device_p95_ms": (
+                _quantile(segs["device"], 0.95) * 1000.0
+                if segs["device"] else None
+            ),
+        }
+    return out
+
+
+def trace_report(path: str | Path, out=print) -> int:
+    """The ``--trace`` view: merge kept trace spans across the router's
+    and every replica process's event files (clock skew already removed
+    by ``load_run``) and render the per-SLO-class critical-path
+    decomposition — p50/p95/p99 of each segment, widest p95 starred.
+
+    Exit 0 normally (including a run with zero kept traces and zero
+    breaches), 1 when a class with a declared deadline shows breaches in
+    its ``serve_route`` counters but ZERO kept traces — the one state
+    tail-based keep is supposed to make impossible, so it must fail the
+    gate rather than pass silently, 2 when ``path`` has no events."""
+    events, _files = load_run(path)
+    if not events:
+        out(f"{path}: no events found")
+        return 2
+    rows = trace_rows(events)
+    kept_by: dict[str, int] = {}
+    for t in rows:
+        kept_by[t["keep"] or "?"] = kept_by.get(t["keep"] or "?", 0) + 1
+    out(
+        f"kept traces: {len(rows)}"
+        + (
+            " ("
+            + ", ".join(f"{k} {v}" for k, v in sorted(kept_by.items()))
+            + ")"
+            if kept_by else ""
+        )
+    )
+    rc = 0
+    # the tail-keep contract: every deadline breach keeps its trace, so
+    # a deadlined class with breaches on the books but no kept traces
+    # means the tracer was off or broken for exactly the requests it
+    # exists for
+    for name, crow in sorted(serve_class_table(events).items()):
+        if not crow.get("deadline_ms"):
+            continue
+        breaches = (
+            max(0, crow["completed"] - crow["ok_deadline"])
+            + crow["expired"]
+        )
+        kept = sum(1 for t in rows if t["cls"] == name)
+        if breaches > 0 and kept == 0:
+            out(
+                f"NO TRACES FOR BREACHED CLASS: {name} shows {breaches} "
+                f"deadline breach(es) in serve_route but zero kept "
+                f"traces — tail-based keep should have kept every one"
+            )
+            rc = 1
+    per = trace_class_segments(events)
+    for cls in sorted(per):
+        segs = per[cls]
+        p95s = {
+            s: _quantile(segs[s], 0.95)
+            for s in TRACE_SEGMENTS if segs[s]
+        }
+        widest = max(p95s, key=p95s.get) if p95s else None
+        out("")
+        out(f"class {cls} — {segs['n']} kept trace(s)")
+        header = (
+            f"  {'segment':<10} {'n':>5} {'p50 ms':>9} {'p95 ms':>9} "
+            f"{'p99 ms':>9}"
+        )
+        out(header)
+        out("  " + "-" * (len(header) - 2))
+        for seg in TRACE_SEGMENTS:
+            vals = segs[seg]
+            if not vals:
+                out(f"  {seg:<10} {0:>5} {'-':>9} {'-':>9} {'-':>9}")
+                continue
+            star = " *widest" if seg == widest else ""
+            out(
+                f"  {seg:<10} {len(vals):>5} "
+                f"{_quantile(vals, 0.50) * 1000:>9.3f} "
+                f"{_quantile(vals, 0.95) * 1000:>9.3f} "
+                f"{_quantile(vals, 0.99) * 1000:>9.3f}{star}"
+            )
+    # the requeue trail: one trace spanning every replica it touched
+    requeued = [t for t in rows if t["requeues"]]
+    if requeued:
+        out("")
+        for t in requeued:
+            rids = ", ".join(
+                "?" if r is None else str(r) for r in t["rids"]
+            )
+            out(
+                f"requeued trace {t['trace_id']}: {t['requeues']} "
+                f"requeue(s) across replicas [{rids}] — "
+                f"outcome {t['outcome']}"
+            )
+    return rc
+
+
 def _plan_layout_of_run_start(p: dict) -> dict:
     """The layout a ``run_start`` payload actually ran — the comparison
     frame of a ``plan`` event's ``layout`` dict."""
@@ -1881,9 +2110,23 @@ def format_diff(name_a: str, a: dict, name_b: str, b: dict) -> str:
             100 * cb["mfu"] if cb.get("mfu") is not None else None,
         ),
     ]
+    # per-class trace-segment p95s (request tracing): absent segments —
+    # no kept traces, or a transport with no socket hop — stay None and
+    # render '-'; a fabricated 0.0 would read as a measured improvement
+    ta = a.get("trace_classes") or {}
+    tb = b.get("trace_classes") or {}
+    for cls in sorted(set(ta) | set(tb)):
+        ra, rb = ta.get(cls) or {}, tb.get(cls) or {}
+        for label, key in (
+            ("queue p95 ms", "queue_p95_ms"),
+            ("transp p95 ms", "transport_p95_ms"),
+            ("device p95 ms", "device_p95_ms"),
+        ):
+            rows.append((f"{cls} {label}", ra.get(key), rb.get(key)))
     w = max(len(name_a), len(name_b), 12)
+    lw = max(14, max(len(label) for label, _, _ in rows))
     lines = [
-        f"{'':<14} {name_a[:w]:>{w}} {name_b[:w]:>{w}} {'Δ':>10}",
+        f"{'':<{lw}} {name_a[:w]:>{w}} {name_b[:w]:>{w}} {'Δ':>10}",
     ]
     for label, va, vb in rows:
         delta = None if va is None or vb is None else vb - va
@@ -1894,7 +2137,7 @@ def format_diff(name_a: str, a: dict, name_b: str, b: dict) -> str:
         )
         cell = lambda v: "-" if v is None else fmt(v)  # noqa: E731
         lines.append(
-            f"{label:<14} {cell(va):>{w}} {cell(vb):>{w}} {cell(delta):>10}"
+            f"{label:<{lw}} {cell(va):>{w}} {cell(vb):>{w}} {cell(delta):>10}"
         )
     return "\n".join(lines)
 
@@ -1989,6 +2232,16 @@ def main(argv: list[str]) -> int:
         "bench leg's self-check",
     )
     ap.add_argument(
+        "--trace", action="store_true",
+        help="merge kept request-trace spans across the router's and "
+        "every replica process's event files (clock skew removed) and "
+        "print the per-SLO-class critical-path decomposition — "
+        "p50/p95/p99 of admission / queue wait / coalescing / socket "
+        "hop / device / reply, widest p95 starred, plus the requeue "
+        "trail of any trace that survived a replica death; exit 1 when "
+        "a deadlined class shows breaches but zero kept traces",
+    )
+    ap.add_argument(
         "--export-openmetrics", metavar="OUT", default=None, nargs="?",
         const="-",
         help="render the run's merged metrics/heartbeats/alerts in the "
@@ -2047,6 +2300,12 @@ def main(argv: list[str]) -> int:
         rc = 0
         for path in args.paths:
             rc = max(rc, serve_report(path))
+        return rc
+
+    if args.trace:
+        rc = 0
+        for path in args.paths:
+            rc = max(rc, trace_report(path))
         return rc
 
     if args.export_openmetrics is not None:
